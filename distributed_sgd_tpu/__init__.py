@@ -1,0 +1,26 @@
+"""tpu-dsgd: a TPU-native distributed SGD framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the JVM reference
+``zifeo/distributed-sgd`` (see SURVEY.md):
+
+- synchronous data-parallel SGD (master-coordinated per-batch gradient
+  aggregation -> `jax.lax.psum` over a device mesh, reference
+  core/Master.scala:179-198),
+- asynchronous Hogwild SGD with peer gossip of weight deltas (reference
+  core/Slave.scala:79-111), both as a host-driven gossip mode and as an
+  on-mesh local-SGD mode,
+- sparse hinge-loss SVM on RCV1 (804,414 samples x 47,236 features,
+  reference core/ml/SparseSVM.scala, utils/Dataset.scala),
+- cluster membership/readiness over gRPC (reference proto.proto),
+- early stopping, split strategies, leaky-smoothed async loss checking
+  with best-weights tracking, typed env-overridable config,
+  span/counter/histogram observability, checkpointing (superset).
+
+The compute hot path is compiled XLA: padded-sparse batched matvec +
+segment-scatter gradients on device, collectives over ICI/DCN instead of
+message-passing reduce.
+"""
+
+__version__ = "0.1.0"
+
+from distributed_sgd_tpu.config import Config  # noqa: F401

@@ -1,0 +1,110 @@
+"""Typed configuration with ``DSGD_*`` environment overrides.
+
+Mirrors the reference's 17-field pureconfig case class
+(utils/Config.scala:3-21) and its per-key env override scheme
+(src/main/resources/application.conf:1-52).  Role selection follows the
+reference (Main.scala:122-159): if ``master_host``/``master_port`` are unset
+the process runs an in-process dev cluster; if they equal the node's own
+host/port the process is the master; otherwise it is a worker.
+
+Capability supersets over the reference (documented, opt-in):
+``model`` (hinge | logistic | least_squares), ``checkpoint_dir`` (orbax),
+``async_mode`` (gossip | local_sgd), ``sync_period`` for on-mesh local-SGD.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _env(name: str, default, cast):
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    if cast is bool:
+        return raw.strip().lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclass
+class Config:
+    # -- reference-parity fields (utils/Config.scala:3-21) ------------------
+    host: str = "127.0.0.1"
+    port: int = 4000
+    master_host: Optional[str] = None
+    master_port: Optional[int] = None
+    batch_size: int = 100
+    learning_rate: float = 0.5
+    lam: float = 1e-5  # `lambda` in the reference; keyword in Python
+    node_count: int = 3
+    full: bool = False
+    use_async: bool = False  # `async` in the reference; keyword in Python
+    record: bool = False
+    data_path: str = "data"
+    max_epochs: int = 10
+    check_every: int = 100
+    leaky_loss: float = 0.9
+    conv_delta: float = 0.01
+    patience: int = 5
+
+    # -- TPU-native extensions ---------------------------------------------
+    model: str = "hinge"  # hinge | logistic | least_squares
+    seed: int = 0
+    async_mode: str = "gossip"  # gossip | local_sgd
+    sync_period: int = 16  # local-SGD averaging period (steps)
+    checkpoint_dir: Optional[str] = None
+    metrics_port: Optional[int] = None  # Prometheus-style text exporter
+    profile_dir: Optional[str] = None  # jax.profiler trace output
+    pad_width: Optional[int] = None  # sparse-batch nnz padding (None = auto)
+
+    @property
+    def role(self) -> str:
+        """'dev' | 'master' | 'worker', per Main.scala:122-159."""
+        if self.master_host is None or self.master_port is None:
+            return "dev"
+        if (self.master_host, self.master_port) == (self.host, self.port):
+            return "master"
+        return "worker"
+
+    @classmethod
+    def from_env(cls, **overrides) -> "Config":
+        """Build from DSGD_* env vars (application.conf:1-52 names)."""
+        cfg = cls(
+            host=_env("DSGD_NODE_HOST", cls.host, str),
+            port=_env("DSGD_NODE_PORT", cls.port, int),
+            master_host=_env("DSGD_MASTER_HOST", None, str),
+            master_port=_env("DSGD_MASTER_PORT", None, int),
+            batch_size=_env("DSGD_BATCH_SIZE", cls.batch_size, int),
+            learning_rate=_env("DSGD_LEARNING_RATE", cls.learning_rate, float),
+            lam=_env("DSGD_LAMBDA", cls.lam, float),
+            node_count=_env("DSGD_NODE_COUNT", cls.node_count, int),
+            full=_env("DSGD_FULL", cls.full, bool),
+            use_async=_env("DSGD_ASYNC", cls.use_async, bool),
+            record=_env("DSGD_RECORD", cls.record, bool),
+            data_path=_env("DSGD_DATA_PATH", cls.data_path, str),
+            max_epochs=_env("DSGD_MAX_EPOCHS", cls.max_epochs, int),
+            check_every=_env("DSGD_CHECK_EVERY", cls.check_every, int),
+            leaky_loss=_env("DSGD_LEAKY_LOSS", cls.leaky_loss, float),
+            conv_delta=_env("DSGD_CONV_DELTA", cls.conv_delta, float),
+            patience=_env("DSGD_PATIENCE", cls.patience, int),
+            model=_env("DSGD_MODEL", cls.model, str),
+            seed=_env("DSGD_SEED", cls.seed, int),
+            async_mode=_env("DSGD_ASYNC_MODE", cls.async_mode, str),
+            sync_period=_env("DSGD_SYNC_PERIOD", cls.sync_period, int),
+            checkpoint_dir=_env("DSGD_CHECKPOINT_DIR", None, str),
+            metrics_port=_env("DSGD_METRICS_PORT", None, int),
+            profile_dir=_env("DSGD_PROFILE_DIR", None, str),
+            pad_width=_env("DSGD_PAD_WIDTH", None, int),
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "Config":
+        return cls(**json.loads(s))

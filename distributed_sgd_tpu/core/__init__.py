@@ -1,0 +1,3 @@
+from distributed_sgd_tpu.core.early_stopping import no_improvement, target  # noqa: F401
+from distributed_sgd_tpu.core.grad_state import GradState  # noqa: F401
+from distributed_sgd_tpu.core.split import vanilla_split  # noqa: F401

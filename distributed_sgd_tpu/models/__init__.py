@@ -1,0 +1,7 @@
+from distributed_sgd_tpu.models.linear import (  # noqa: F401
+    LeastSquares,
+    LinearModel,
+    LogisticRegression,
+    SparseSVM,
+    make_model,
+)

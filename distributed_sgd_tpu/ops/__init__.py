@@ -1,0 +1,6 @@
+from distributed_sgd_tpu.ops.sparse import (  # noqa: F401
+    SparseBatch,
+    matvec,
+    pad_rows,
+    scatter_add,
+)

@@ -1,0 +1,8 @@
+from distributed_sgd_tpu.utils.measure import duration, duration_log, span  # noqa: F401
+from distributed_sgd_tpu.utils.metrics import (  # noqa: F401
+    Metrics,
+    counter,
+    global_metrics,
+    histogram,
+    timer,
+)

@@ -1,0 +1,50 @@
+"""Wall-clock measurement spans.
+
+TPU-native equivalent of the reference's ``Measure`` helpers
+(utils/Measure.scala:11-35): `duration` returns (result, seconds),
+`duration_log` logs a named span, and `span` is a context manager that also
+feeds the metrics registry so spans show up in exporters.  For device work,
+callers must account for JAX async dispatch themselves (block_until_ready)
+— the trainer does this at epoch boundaries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from typing import Callable, Tuple, TypeVar
+
+T = TypeVar("T")
+
+log = logging.getLogger("dsgd.measure")
+
+
+def duration(fn: Callable[[], T]) -> Tuple[T, float]:
+    """Run `fn`, return (result, elapsed_seconds). Measure.scala:11-16."""
+    t0 = time.perf_counter()
+    out = fn()
+    return out, time.perf_counter() - t0
+
+
+def duration_log(name: str, fn: Callable[[], T], logger=None) -> T:
+    """Run `fn` and log '<name>: Xs'. Measure.scala:18-24."""
+    out, secs = duration(fn)
+    (logger or log).info("%s (%.3fs)", name, secs)
+    return out
+
+
+@contextlib.contextmanager
+def span(name: str, logger=None, metrics=None):
+    """Context-manager span: logs elapsed and records a histogram sample."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        secs = time.perf_counter() - t0
+        (logger or log).debug("%s (%.3fs)", name, secs)
+        if metrics is None:
+            from distributed_sgd_tpu.utils.metrics import global_metrics
+
+            metrics = global_metrics()
+        metrics.histogram(f"span.{name}").record(secs)

@@ -1,0 +1,210 @@
+"""Counters / histograms / timers with pluggable exporters.
+
+TPU-native equivalent of the reference's Kamon surface (SURVEY.md §5.1):
+the reference records `master.sync.batch.duration` (timer),
+`master.sync.loss` / `master.sync.acc` (histograms), and per-slave counters
+(`slave.async.backward`, `slave.async.batch`, `slave.async.grad.update`,
+`slave.sync.forward`, `slave.sync.backward`) via Kamon -> InfluxDB
+(Master.scala:150-193, Slave.scala:90-181, MasterAsync.scala:126).
+
+This module provides the same instrument names through a thread-safe
+registry, plus two exporters:
+
+- `PrometheusExporter`: an HTTP endpoint serving the text exposition format
+  (the modern k8s-native replacement for the Kamon->InfluxDB push path).
+- `influx_lines()`: InfluxDB line-protocol rendering for push-based setups,
+  matching the reference's InfluxDBReporter output shape.
+"""
+
+from __future__ import annotations
+
+import http.server
+import math
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class Counter:
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Histogram:
+    """Streaming histogram: count/sum/min/max/mean + last value.
+
+    The reference's Kamon histograms feed Grafana percentile panels; here we
+    keep cheap streaming aggregates (enough for the same dashboards) rather
+    than full HDR buckets.
+    """
+
+    __slots__ = ("name", "count", "sum", "min", "max", "last", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.last = float("nan")
+        self._lock = threading.Lock()
+
+    def record(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = min(self.min, v)
+            self.max = max(self.max, v)
+            self.last = v
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else float("nan")
+
+
+class Timer:
+    """Histogram of elapsed seconds with a context-manager interface."""
+
+    def __init__(self, hist: Histogram):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.record(time.perf_counter() - self._t0)
+        return False
+
+
+class Metrics:
+    """Thread-safe named-instrument registry."""
+
+    def __init__(self, tags: Optional[Dict[str, str]] = None):
+        self.tags = dict(tags or {})
+        self._counters: Dict[str, Counter] = {}
+        self._hists: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter(name))
+
+    def histogram(self, name: str) -> Histogram:
+        with self._lock:
+            return self._hists.setdefault(name, Histogram(name))
+
+    def timer(self, name: str) -> Timer:
+        return Timer(self.histogram(name))
+
+    # -- exporters ---------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        tags = ",".join(f'{k}="{v}"' for k, v in sorted(self.tags.items()))
+        tagstr = "{" + tags + "}" if tags else ""
+
+        def mangle(name: str) -> str:
+            return name.replace(".", "_").replace("-", "_")
+
+        lines: List[str] = []
+        for c in list(self._counters.values()):
+            lines.append(f"# TYPE {mangle(c.name)} counter")
+            lines.append(f"{mangle(c.name)}{tagstr} {c.value}")
+        for h in list(self._hists.values()):
+            base = mangle(h.name)
+            lines.append(f"# TYPE {base} summary")
+            lines.append(f"{base}_count{tagstr} {h.count}")
+            lines.append(f"{base}_sum{tagstr} {h.sum}")
+            if h.count:
+                # min/max are separate gauge families: a summary family only
+                # admits quantile/_sum/_count samples in the exposition format
+                lines.append(f"# TYPE {base}_min gauge")
+                lines.append(f"{base}_min{tagstr} {h.min}")
+                lines.append(f"# TYPE {base}_max gauge")
+                lines.append(f"{base}_max{tagstr} {h.max}")
+        return "\n".join(lines) + "\n"
+
+    def influx_lines(self, ts_ns: Optional[int] = None) -> str:
+        """InfluxDB line protocol, the reference's push format."""
+        ts = ts_ns if ts_ns is not None else time.time_ns()
+        tags = "".join(f",{k}={v}" for k, v in sorted(self.tags.items()))
+        lines = []
+        for c in list(self._counters.values()):
+            lines.append(f"{c.name}{tags} value={c.value}i {ts}")
+        for h in list(self._hists.values()):
+            if h.count:
+                lines.append(
+                    f"{h.name}{tags} count={h.count}i,sum={h.sum},"
+                    f"min={h.min},max={h.max},mean={h.mean} {ts}"
+                )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+_GLOBAL = Metrics()
+
+
+def global_metrics() -> Metrics:
+    return _GLOBAL
+
+
+def counter(name: str) -> Counter:
+    return _GLOBAL.counter(name)
+
+
+def histogram(name: str) -> Histogram:
+    return _GLOBAL.histogram(name)
+
+
+def timer(name: str) -> Timer:
+    return _GLOBAL.timer(name)
+
+
+class PrometheusExporter:
+    """Tiny HTTP exporter for the Prometheus text format.
+
+    Replaces the reference's Kamon InfluxDBReporter push loop
+    (Main.scala:40-43, application.conf:54-77) with the pull model native to
+    the k8s deployments in kube/.
+    """
+
+    def __init__(self, metrics: Metrics, port: int, host: str = "0.0.0.0"):
+        self.metrics = metrics
+
+        registry = metrics
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                body = registry.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> "PrometheusExporter":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

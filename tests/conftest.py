@@ -1,0 +1,14 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip behavior is tested without TPU hardware the same way the
+reference tests distribution without a cluster — the reference loops real
+gRPC through one JVM (Main.scala:143-158); we run real shard_map/pjit
+shardings over 8 virtual CPU devices (SURVEY.md §4)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

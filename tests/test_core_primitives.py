@@ -1,0 +1,82 @@
+"""Split-strategy, GradState, config, and metrics unit tests."""
+
+import numpy as np
+
+from distributed_sgd_tpu.config import Config
+from distributed_sgd_tpu.core.grad_state import GradState
+from distributed_sgd_tpu.core.split import shuffled_split, strided_split, vanilla_split
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+
+def test_vanilla_split_sizes():
+    # ceil(10/3)=4 -> sizes 4,4,2 (SplitStrategy.scala:13-14)
+    parts = vanilla_split(10, 3)
+    assert [len(p) for p in parts] == [4, 4, 2]
+    assert np.concatenate(parts).tolist() == list(range(10))
+
+
+def test_vanilla_split_pads_empty_workers():
+    parts = vanilla_split(4, 8)
+    assert len(parts) == 8
+    assert sum(len(p) for p in parts) == 4
+
+
+def test_strided_split_partitions():
+    parts = strided_split(10, 3)
+    assert sorted(np.concatenate(parts).tolist()) == list(range(10))
+    assert parts[0].tolist() == [0, 3, 6, 9]
+
+
+def test_shuffled_split_deterministic_partition():
+    a = shuffled_split(20, 4, seed=7)
+    b = shuffled_split(20, 4, seed=7)
+    assert all((x == y).all() for x, y in zip(a, b))
+    assert sorted(np.concatenate(a).tolist()) == list(range(20))
+
+
+def test_grad_state_update_and_finish():
+    s = GradState(weights=np.array([1.0, 2.0]))
+    s2 = s.update(np.array([0.5, 0.5]))
+    assert s2.updates == 1
+    np.testing.assert_allclose(s2.weights, [0.5, 1.5])
+    assert s2.end is None
+    s3 = s2.finish()
+    assert s3.duration is not None and s3.duration >= 0
+
+
+def test_config_roles():
+    assert Config().role == "dev"
+    assert Config(master_host="127.0.0.1", master_port=4000).role == "master"
+    assert Config(master_host="10.0.0.1", master_port=4000).role == "worker"
+
+
+def test_config_env_overrides(monkeypatch):
+    monkeypatch.setenv("DSGD_BATCH_SIZE", "256")
+    monkeypatch.setenv("DSGD_ASYNC", "true")
+    monkeypatch.setenv("DSGD_LAMBDA", "0.001")
+    cfg = Config.from_env()
+    assert cfg.batch_size == 256
+    assert cfg.use_async is True
+    assert cfg.lam == 0.001
+
+
+def test_config_json_roundtrip():
+    cfg = Config(batch_size=42, model="logistic")
+    assert Config.from_json(cfg.to_json()) == cfg
+
+
+def test_metrics_counters_histograms_exporters():
+    m = Metrics(tags={"node": "slave-1:4001"})
+    m.counter("slave.async.backward").increment()
+    m.counter("slave.async.backward").increment(2)
+    with m.timer("master.sync.batch.duration"):
+        pass
+    m.histogram("master.sync.loss").record(0.5)
+    m.histogram("master.sync.loss").record(0.3)
+    assert m.counter("slave.async.backward").value == 3
+    h = m.histogram("master.sync.loss")
+    assert h.count == 2 and abs(h.mean - 0.4) < 1e-9
+    text = m.prometheus_text()
+    assert "slave_async_backward" in text and 'node="slave-1:4001"' in text
+    lines = m.influx_lines(ts_ns=123)
+    assert "master.sync.loss" in lines and lines.strip().endswith("123")

@@ -1,0 +1,96 @@
+"""Golden-value model tests against the reference formulas
+(core/ml/SparseSVM.scala:14-31); values computed by hand in the comments."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.models.linear import (
+    LeastSquares,
+    LogisticRegression,
+    SparseSVM,
+    make_model,
+)
+from distributed_sgd_tpu.ops.sparse import SparseBatch
+
+D = 6
+W = jnp.array([0.1, 0.2, -0.3, 0.4, 0.0, 0.0])
+Y = jnp.array([1, -1])
+
+
+def _batch():
+    idx = jnp.array([[0, 2, 0], [1, 3, 0]], dtype=jnp.int32)
+    val = jnp.array([[1.0, 2.0, 0.0], [-1.0, 0.5, 0.0]], dtype=jnp.float32)
+    return SparseBatch(idx, val)
+
+
+def _svm(reg="l2", ds=None):
+    return SparseSVM(lam=0.1, n_features=D, dim_sparsity=ds, regularizer=reg)
+
+
+def test_svm_forward_sign_flip():
+    # margins [-0.5, 0.0] -> signum * -1 -> [+1, 0]  (SparseSVM.scala:14)
+    preds = _svm().forward(W, _batch())
+    np.testing.assert_allclose(np.asarray(preds), [1.0, 0.0])
+
+
+def test_svm_objective_golden():
+    # lam*||w||^2 + mean hinge = 0.1*0.3 + (0 + 1)/2 = 0.53
+    obj = _svm().objective(W, _batch(), Y)
+    np.testing.assert_allclose(float(obj), 0.53, atol=1e-6)
+
+
+def test_svm_grad_sum_golden():
+    # sample0: activity = 1*(-0.5) < 0 -> zero grad (SparseSVM.scala:26-29)
+    # sample1: activity = -1*0 = 0 (not < 0) -> y*x = -1*{1:-1, 3:0.5}
+    g = _svm().grad_sum(W, _batch(), Y)
+    np.testing.assert_allclose(np.asarray(g), [0, 1.0, 0, -0.5, 0, 0], atol=1e-6)
+
+
+def test_svm_accuracy_counts_zero_pred_as_wrong():
+    acc = _svm().accuracy(W, _batch(), Y)
+    np.testing.assert_allclose(float(acc), 0.5)
+
+
+def test_regularize_dim_sparsity_only_on_support():
+    ds = jnp.full((D,), 0.5)
+    m = _svm(reg="dim_sparsity", ds=ds)
+    g = m.grad_sum(W, _batch(), Y)
+    # scalar = lam*2*(w . ds) = 0.1*2*0.4*0.5 = 0.04, added only where g != 0
+    rg = m.regularize(g, W)
+    np.testing.assert_allclose(np.asarray(rg), [0, 1.04, 0, -0.46, 0, 0], atol=1e-6)
+
+
+def test_regularize_l2():
+    m = _svm(reg="l2")
+    g = jnp.zeros((D,))
+    rg = m.regularize(g, W)
+    np.testing.assert_allclose(np.asarray(rg), 2 * 0.1 * np.asarray(W), atol=1e-6)
+
+
+def test_logistic_gradient_matches_autodiff():
+    import jax
+
+    m = LogisticRegression(lam=0.0, n_features=D, regularizer="none")
+    b = _batch()
+    auto = jax.grad(lambda w: m.objective(w, b, Y))(W)
+    manual = m.grad_mean(W, b, Y)
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(manual), atol=1e-4)
+
+
+def test_least_squares_gradient_matches_autodiff():
+    import jax
+
+    m = LeastSquares(lam=0.0, n_features=D, regularizer="none")
+    b = _batch()
+    auto = jax.grad(lambda w: m.objective(w, b, Y))(W)
+    manual = m.grad_mean(W, b, Y)
+    np.testing.assert_allclose(np.asarray(auto), np.asarray(manual), atol=1e-4)
+
+
+def test_make_model_dispatch():
+    assert isinstance(make_model("hinge", 0.1, D), SparseSVM)
+    assert isinstance(make_model("logistic", 0.1, D), LogisticRegression)
+    assert isinstance(make_model("least_squares", 0.1, D), LeastSquares)
+    with pytest.raises(ValueError):
+        make_model("mlp", 0.1, D)
