@@ -1,0 +1,9 @@
+from distributed_sgd_tpu.data.rcv1 import (  # noqa: F401
+    Dataset,
+    dim_sparsity,
+    load_rcv1,
+    pack_csr,
+    read_labels,
+    train_test_split,
+)
+from distributed_sgd_tpu.data.synthetic import rcv1_like, dense_regression  # noqa: F401

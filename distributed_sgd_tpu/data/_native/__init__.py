@@ -1,0 +1,90 @@
+"""ctypes loader for the native CSR parser, with build-on-first-use.
+
+pybind11 is not available in this image, so the native parser exposes a C
+ABI (parser.cpp) loaded via ctypes.  The shared library is compiled with
+g++ on first use and cached next to the source, keyed by source mtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+log = logging.getLogger("dsgd.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "parser.cpp")
+_LIB = os.path.join(_DIR, "_libdsgd_parser.so")
+_LOCK = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class _CsrResult(ctypes.Structure):
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("nnz", ctypes.c_int64),
+        ("doc_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("row_ptr", ctypes.POINTER(ctypes.c_int64)),
+        ("col_idx", ctypes.POINTER(ctypes.c_int32)),
+        ("values", ctypes.POINTER(ctypes.c_float)),
+    ]
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-pthread", _SRC, "-o", _LIB,
+    ]
+    log.info("building native parser: %s", " ".join(cmd))
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Build (if stale) and load the native library; None if unavailable."""
+    global _lib
+    with _LOCK:
+        if _lib is not None:
+            return _lib
+        try:
+            if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+            lib.dsgd_parse_svm.restype = ctypes.POINTER(_CsrResult)
+            lib.dsgd_parse_svm.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int32]
+            lib.dsgd_free_csr.argtypes = [ctypes.POINTER(_CsrResult)]
+            lib.dsgd_free_csr.restype = None
+            _lib = lib
+        except Exception as e:  # missing toolchain etc. -> python fallback
+            log.warning("native parser unavailable (%s); using python fallback", e)
+            _lib = None
+        return _lib
+
+
+def parse_svm_file(
+    path: str, n_threads: int = 0, index_offset: int = -1
+) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]]:
+    """Parse with the native library. Returns (doc_ids, row_ptr, col_idx,
+    values) as owned numpy arrays, or None if the native path is unavailable.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    res = lib.dsgd_parse_svm(path.encode(), n_threads, index_offset)
+    if not res:
+        raise IOError(f"native parser failed to open {path!r}")
+    try:
+        r = res.contents
+        n, nnz = r.n_rows, r.nnz
+        doc_ids = np.ctypeslib.as_array(r.doc_ids, shape=(n,)).copy()
+        row_ptr = np.ctypeslib.as_array(r.row_ptr, shape=(n + 1,)).copy()
+        col_idx = np.ctypeslib.as_array(r.col_idx, shape=(max(nnz, 1),))[:nnz].copy()
+        values = np.ctypeslib.as_array(r.values, shape=(max(nnz, 1),))[:nnz].copy()
+        return doc_ids, row_ptr, col_idx, values
+    finally:
+        lib.dsgd_free_csr(res)

@@ -1,0 +1,169 @@
+// Native RCV1/LIBSVM-style parser: text rows -> CSR arrays.
+//
+// TPU-native replacement for the reference's startup-dominating data path
+// (utils/Dataset.scala:19-34): the reference parses "docid  f:v f:v ..."
+// lines into boxed Map[Int, spire.math.Number] with Scala parallel
+// collections; we parse straight into flat CSR buffers (int32 col ids,
+// f32 values, int64 row offsets) with a chunked multi-threaded scan, which
+// is both what the host can do fastest and exactly the layout the packing
+// step (data/rcv1.py) needs to build device tensors.
+//
+// C ABI only (loaded via ctypes; no pybind11 in this image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct ChunkOut {
+  std::vector<int32_t> doc_ids;
+  std::vector<int64_t> row_nnz;
+  std::vector<int32_t> col_idx;
+  std::vector<float> values;
+};
+
+// Parse [begin, end) which is aligned to line boundaries.
+void parse_chunk(const char* begin, const char* end, int32_t index_offset,
+                 ChunkOut* out) {
+  const char* p = begin;
+  while (p < end) {
+    // skip blank lines
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    // doc id
+    char* next = nullptr;
+    long doc = strtol(p, &next, 10);
+    p = next;
+    out->doc_ids.push_back(static_cast<int32_t>(doc));
+    int64_t nnz = 0;
+    // feature:value pairs until end of line
+    while (p < end && *p != '\n') {
+      while (p < end && *p == ' ') ++p;
+      if (p >= end || *p == '\n' || *p == '\r') break;
+      long feat = strtol(p, &next, 10);
+      if (next == p) {  // malformed token; skip to next space/newline
+        while (p < end && *p != ' ' && *p != '\n') ++p;
+        continue;
+      }
+      p = next;
+      if (p < end && *p == ':') {
+        ++p;
+        float v = strtof(p, &next);
+        p = next;
+        out->col_idx.push_back(static_cast<int32_t>(feat) + index_offset);
+        out->values.push_back(v);
+        ++nnz;
+      }
+      // token without ':' (e.g. the reference's dropped parts(1)) is skipped
+    }
+    out->row_nnz.push_back(nnz);
+    while (p < end && *p != '\n') ++p;  // consume rest of line
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+struct CsrResult {
+  int64_t n_rows;
+  int64_t nnz;
+  int32_t* doc_ids;  // [n_rows]
+  int64_t* row_ptr;  // [n_rows + 1]
+  int32_t* col_idx;  // [nnz]
+  float* values;     // [nnz]
+};
+
+// Parse a whole file. index_offset is added to every feature id (use -1 to
+// convert the file's 1-based ids to 0-based). Returns nullptr on I/O error.
+CsrResult* dsgd_parse_svm(const char* path, int n_threads,
+                          int32_t index_offset) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::vector<char> buf(static_cast<size_t>(size));
+  if (size > 0 && fread(buf.data(), 1, static_cast<size_t>(size), f) !=
+                      static_cast<size_t>(size)) {
+    fclose(f);
+    return nullptr;
+  }
+  fclose(f);
+
+  if (n_threads < 1) {
+    unsigned hw = std::thread::hardware_concurrency();
+    n_threads = hw ? static_cast<int>(hw) : 1;
+  }
+  // chunk boundaries aligned to newlines
+  std::vector<const char*> bounds;
+  const char* base = buf.data();
+  const char* fend = base + size;
+  bounds.push_back(base);
+  for (int t = 1; t < n_threads; ++t) {
+    const char* guess = base + size * t / n_threads;
+    while (guess < fend && *guess != '\n') ++guess;
+    if (guess < fend) ++guess;
+    bounds.push_back(guess);
+  }
+  bounds.push_back(fend);
+
+  std::vector<ChunkOut> outs(bounds.size() - 1);
+  std::vector<std::thread> threads;
+  for (size_t t = 0; t + 1 < bounds.size(); ++t) {
+    if (bounds[t] >= bounds[t + 1]) continue;
+    threads.emplace_back(parse_chunk, bounds[t], bounds[t + 1], index_offset,
+                         &outs[t]);
+  }
+  for (auto& th : threads) th.join();
+
+  auto* res = static_cast<CsrResult*>(malloc(sizeof(CsrResult)));
+  int64_t n_rows = 0, nnz = 0;
+  for (auto& o : outs) {
+    n_rows += static_cast<int64_t>(o.doc_ids.size());
+    nnz += static_cast<int64_t>(o.values.size());
+  }
+  res->n_rows = n_rows;
+  res->nnz = nnz;
+  res->doc_ids = static_cast<int32_t*>(malloc(sizeof(int32_t) * n_rows));
+  res->row_ptr = static_cast<int64_t*>(malloc(sizeof(int64_t) * (n_rows + 1)));
+  res->col_idx = static_cast<int32_t*>(malloc(sizeof(int32_t) * (nnz ? nnz : 1)));
+  res->values = static_cast<float*>(malloc(sizeof(float) * (nnz ? nnz : 1)));
+
+  int64_t row_at = 0, nz_at = 0;
+  res->row_ptr[0] = 0;
+  for (auto& o : outs) {
+    if (!o.doc_ids.empty()) {
+      memcpy(res->doc_ids + row_at, o.doc_ids.data(),
+             sizeof(int32_t) * o.doc_ids.size());
+    }
+    for (size_t i = 0; i < o.row_nnz.size(); ++i) {
+      res->row_ptr[row_at + 1] = res->row_ptr[row_at] + o.row_nnz[i];
+      ++row_at;
+    }
+    if (!o.values.empty()) {
+      memcpy(res->col_idx + nz_at, o.col_idx.data(),
+             sizeof(int32_t) * o.col_idx.size());
+      memcpy(res->values + nz_at, o.values.data(),
+             sizeof(float) * o.values.size());
+      nz_at += static_cast<int64_t>(o.values.size());
+    }
+  }
+  return res;
+}
+
+void dsgd_free_csr(CsrResult* r) {
+  if (!r) return;
+  free(r->doc_ids);
+  free(r->row_ptr);
+  free(r->col_idx);
+  free(r->values);
+  free(r);
+}
+
+}  // extern "C"

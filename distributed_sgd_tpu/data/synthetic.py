@@ -1,0 +1,58 @@
+"""Synthetic datasets for tests and benchmarks.
+
+`rcv1_like` generates a packed sparse classification set with RCV1-shaped
+statistics (cosine-normalized rows, ~76 nnz per row over 47,236 features by
+default) from a planted linear separator with label noise — used wherever
+the real RCV1 files are unavailable (no network egress) and by BASELINE.md
+config 5's dense least-squares problem via `dense_regression`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_sgd_tpu.data.rcv1 import Dataset
+
+
+def rcv1_like(
+    n_samples: int,
+    n_features: int = 47236,
+    nnz: int = 76,
+    noise: float = 0.05,
+    seed: int = 0,
+) -> Dataset:
+    """Planted-separator sparse classification data, packed [N, P]."""
+    rng = np.random.default_rng(seed)
+    # Zipf-ish feature popularity like term frequencies
+    pop = 1.0 / np.arange(1, n_features + 1, dtype=np.float64)
+    pop /= pop.sum()
+    idx = rng.choice(n_features, size=(n_samples, nnz), p=pop).astype(np.int32)
+    idx.sort(axis=1)
+    val = np.abs(rng.normal(size=(n_samples, nnz))).astype(np.float32)
+    val /= np.maximum(np.linalg.norm(val, axis=1, keepdims=True), 1e-12)  # cosine norm
+
+    w_true = rng.normal(size=n_features).astype(np.float32)
+    margins = np.einsum("np,np->n", val, w_true[idx])
+    y = np.where(margins > np.median(margins), 1, -1).astype(np.int32)
+    flip = rng.random(n_samples) < noise
+    y[flip] = -y[flip]
+    return Dataset(indices=idx, values=val, labels=y, n_features=n_features)
+
+
+def dense_regression(
+    n_samples: int,
+    n_features: int = 1024,
+    noise: float = 0.01,
+    seed: int = 0,
+) -> Dataset:
+    """Dense least-squares data in the packed representation.
+
+    Every row stores all features (indices = arange), so the same sparse
+    kernels run it; labels are float targets (BASELINE.md config 5).
+    """
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n_samples, n_features)).astype(np.float32)
+    w_true = rng.normal(size=n_features).astype(np.float32)
+    y = x @ w_true + noise * rng.normal(size=n_samples).astype(np.float32)
+    idx = np.broadcast_to(np.arange(n_features, dtype=np.int32), (n_samples, n_features)).copy()
+    return Dataset(indices=idx, values=x, labels=y.astype(np.float32), n_features=n_features)

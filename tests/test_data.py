@@ -1,0 +1,131 @@
+"""Data-layer tests: native vs python parser parity on a reference-format
+fixture, label binarization quirks, packing, stats, and synthetic data.
+
+Fixture mirrors the RCV1 file formats parsed by the reference
+(utils/Dataset.scala:19-45): vectors 'docid  f:v f:v ...' (double space
+after the id) and qrels 'TOPIC docid 1'."""
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.data import _native
+from distributed_sgd_tpu.data.rcv1 import (
+    Dataset,
+    dim_sparsity,
+    load_rcv1,
+    pack_csr,
+    parse_svm_file_py,
+    read_labels,
+    train_test_split,
+)
+from distributed_sgd_tpu.data.synthetic import dense_regression, rcv1_like
+
+VEC_CONTENT = (
+    "2286  1:0.5 7:0.25 47236:1.0\n"
+    "2287  2:0.125\n"
+    "2288  1:0.75 3:-0.5 4:0.0625 9:0.3\n"
+)
+QRELS_CONTENT = (
+    "C15 2286 1\n"
+    "CCAT 2286 1\n"
+    "CCAT 2287 1\n"
+    "GCAT 2287 1\n"
+    "MCAT 2288 1\n"
+)
+
+
+@pytest.fixture
+def rcv1_dir(tmp_path):
+    (tmp_path / "lyrl2004_vectors_train.dat").write_text(VEC_CONTENT)
+    (tmp_path / "rcv1-v2.topics.qrels").write_text(QRELS_CONTENT)
+    return str(tmp_path)
+
+
+def test_python_parser_golden(rcv1_dir):
+    doc_ids, row_ptr, col_idx, values = parse_svm_file_py(
+        rcv1_dir + "/lyrl2004_vectors_train.dat"
+    )
+    assert doc_ids.tolist() == [2286, 2287, 2288]
+    assert row_ptr.tolist() == [0, 3, 4, 8]
+    # 1-based file ids converted to 0-based
+    assert col_idx.tolist() == [0, 6, 47235, 1, 0, 2, 3, 8]
+    np.testing.assert_allclose(values[:4], [0.5, 0.25, 1.0, 0.125])
+
+
+def test_native_parser_matches_python(rcv1_dir):
+    path = rcv1_dir + "/lyrl2004_vectors_train.dat"
+    native = _native.parse_svm_file(path)
+    assert native is not None, "native parser failed to build"
+    py = parse_svm_file_py(path)
+    for a, b in zip(native, py):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_native_parser_multithreaded_matches(rcv1_dir):
+    path = rcv1_dir + "/lyrl2004_vectors_train.dat"
+    a = _native.parse_svm_file(path, n_threads=1)
+    b = _native.parse_svm_file(path, n_threads=4)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_read_labels_last_topic_wins(rcv1_dir):
+    labels = read_labels(rcv1_dir + "/rcv1-v2.topics.qrels")
+    # 2286: C15 then CCAT -> +1; 2287: CCAT then GCAT -> overwritten to -1
+    # (Dataset.scala:36-45,53 Iterator.toMap quirk); 2288: MCAT -> -1
+    assert labels == {2286: 1, 2287: -1, 2288: -1}
+
+
+def test_load_rcv1_end_to_end(rcv1_dir):
+    ds = load_rcv1(rcv1_dir, full=False)
+    assert len(ds) == 3
+    assert ds.pad_width == 4  # max nnz
+    assert ds.labels.tolist() == [1, -1, -1]
+    # row 1 has a single feature (id 2 -> 0-based 1)
+    assert ds.indices[1].tolist() == [1, 0, 0, 0]
+    np.testing.assert_allclose(ds.values[1], [0.125, 0, 0, 0])
+
+
+def test_pack_csr_truncation_keeps_heaviest():
+    row_ptr = np.array([0, 4], dtype=np.int64)
+    col_idx = np.array([1, 2, 3, 4], dtype=np.int32)
+    values = np.array([0.1, -9.0, 0.2, 5.0], dtype=np.float32)
+    idx, val = pack_csr(row_ptr, col_idx, values, pad_width=2)
+    assert idx[0].tolist() == [2, 4]
+    np.testing.assert_allclose(val[0], [-9.0, 5.0])
+
+
+def test_dim_sparsity_formula():
+    ds = Dataset(
+        indices=np.array([[0, 2], [0, 0]], dtype=np.int32),
+        values=np.array([[1.0, 2.0], [3.0, 0.0]], dtype=np.float32),
+        labels=np.array([1, -1], dtype=np.int32),
+        n_features=4,
+    )
+    s = dim_sparsity(ds)
+    # feature 0 in 2 docs -> 1/3; feature 2 in 1 doc -> 1/2; others 0
+    np.testing.assert_allclose(s, [1 / 3, 0, 1 / 2, 0])
+
+
+def test_train_test_split_contiguous():
+    ds = rcv1_like(10, n_features=50, nnz=3, seed=1)
+    tr, te = train_test_split(ds)
+    assert len(tr) == 8 and len(te) == 2
+    np.testing.assert_array_equal(tr.indices, ds.indices[:8])
+
+
+def test_rcv1_like_stats():
+    ds = rcv1_like(200, n_features=1000, nnz=20, noise=0.0, seed=3)
+    assert ds.indices.shape == (200, 20)
+    norms = np.linalg.norm(ds.values, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+    assert set(np.unique(ds.labels)) == {-1, 1}
+    # planted separator: labels should be ~balanced
+    assert 0.35 < (ds.labels == 1).mean() < 0.65
+
+
+def test_dense_regression_shapes():
+    ds = dense_regression(16, n_features=8, seed=0)
+    assert ds.values.shape == (16, 8)
+    assert ds.indices[0].tolist() == list(range(8))
+    assert ds.labels.dtype == np.float32
