@@ -1,0 +1,129 @@
+"""Host-side training drivers: epoch loop, evaluation, early stopping.
+
+The TPU-native counterpart of the reference master's fit orchestration
+(core/Master.scala:120-218): run one compiled epoch (parallel/sync.py),
+evaluate train+test objective/accuracy on device, feed the *test* loss
+history (newest first) to the stopping criterion — exactly the reference's
+loop structure (early stop on test losses, Master.scala:166; epoch-end
+eval of all four series, Master.scala:201-211) with the per-batch gRPC
+fan-out replaced by `lax.scan` + `psum`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_sgd_tpu.core.early_stopping import Criterion
+from distributed_sgd_tpu.core.grad_state import GradState
+from distributed_sgd_tpu.data.rcv1 import Dataset
+from distributed_sgd_tpu.models.linear import LinearModel
+from distributed_sgd_tpu.parallel.sync import BoundSync, SyncEngine
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+log = logging.getLogger("dsgd.trainer")
+
+
+@dataclass
+class FitResult:
+    state: GradState
+    losses: List[float] = field(default_factory=list)  # chronological
+    accuracies: List[float] = field(default_factory=list)
+    test_losses: List[float] = field(default_factory=list)
+    test_accuracies: List[float] = field(default_factory=list)
+    epochs_run: int = 0
+    epoch_seconds: List[float] = field(default_factory=list)
+
+    @property
+    def weights(self):
+        return self.state.weights
+
+
+class SyncTrainer:
+    """Bulk-synchronous data-parallel trainer over a device mesh."""
+
+    def __init__(
+        self,
+        model: LinearModel,
+        mesh,
+        batch_size: int,
+        learning_rate: float,
+        sampling: str = "fresh",
+        metrics: Optional[metrics_mod.Metrics] = None,
+        seed: int = 0,
+    ):
+        self.engine = SyncEngine(model, mesh, batch_size, learning_rate, sampling=sampling)
+        self.model = model
+        self.metrics = metrics or metrics_mod.global_metrics()
+        self.seed = seed
+
+    def fit(
+        self,
+        train: Dataset,
+        test: Dataset,
+        max_epochs: int,
+        criterion: Optional[Criterion] = None,
+        initial_weights: Optional[jax.Array] = None,
+    ) -> FitResult:
+        bound_train = self.engine.bind(train)
+        bound_test = self.engine.bind(test)
+        w = (
+            jnp.zeros((self.model.n_features,), dtype=jnp.float32)
+            if initial_weights is None
+            else jnp.asarray(initial_weights, dtype=jnp.float32)
+        )
+        key = jax.random.PRNGKey(self.seed)
+        result = FitResult(state=GradState(weights=w))
+        test_losses_newest_first: List[float] = []
+
+        for epoch in range(max_epochs):
+            t0 = time.perf_counter()
+            key, ek = jax.random.split(key)
+            with self.metrics.timer("master.sync.batch.duration"):
+                w = bound_train.epoch(w, ek)
+                jax.block_until_ready(w)
+            epoch_s = time.perf_counter() - t0
+
+            loss, acc = bound_train.evaluate(w)
+            test_loss, test_acc = bound_test.evaluate(w)
+            result.losses.append(loss)
+            result.accuracies.append(acc)
+            result.test_losses.append(test_loss)
+            result.test_accuracies.append(test_acc)
+            result.epoch_seconds.append(epoch_s)
+            result.epochs_run = epoch + 1
+            test_losses_newest_first.insert(0, test_loss)
+
+            self.metrics.histogram("master.sync.loss").record(loss)
+            self.metrics.histogram("master.sync.acc").record(100 * acc)
+            self.metrics.histogram("master.sync.epoch.seconds").record(epoch_s)
+            log.info(
+                "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
+                epoch, loss, acc, test_loss, test_acc, epoch_s,
+            )
+
+            if criterion is not None and criterion(test_losses_newest_first):
+                log.info("Converged to target: stopping computation")
+                break
+        else:
+            if max_epochs > 0:
+                log.info("Reached max number of epochs: stopping computation")
+
+        result.state = GradState(
+            weights=w, loss=result.losses[-1] if result.losses else float("nan")
+        ).finish()
+        return result
+
+    def predict(self, weights: jax.Array, data: Dataset):
+        """Predictions over a split (Master.predict, Master.scala:61-75)."""
+        bound = self.engine.bind(data)
+        return bound.predict(weights)
+
+    def evaluate(self, weights: jax.Array, data: Dataset):
+        """(objective, accuracy) — Master.distributedLoss/Accuracy."""
+        return self.engine.bind(data).evaluate(weights)

@@ -81,6 +81,10 @@ class LinearModel:
     def margins(self, w: jax.Array, batch: SparseBatch) -> jax.Array:
         return matvec(batch, w)
 
+    def sample_losses(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
+        """Per-sample losses (no regularization term), vectorized."""
+        return self.sample_loss(self.forward(w, batch), y)
+
     def forward(self, w: jax.Array, batch: SparseBatch) -> jax.Array:
         return self.predict(self.margins(w, batch))
 
@@ -139,15 +143,17 @@ class LogisticRegression(LinearModel):
         return jnp.where(margins >= 0, 1.0, -1.0)
 
     def sample_loss(self, preds: jax.Array, y: jax.Array) -> jax.Array:
-        del preds  # logistic loss is margin-based; recomputed via margins
-        raise NotImplementedError("use objective()")
+        del preds  # logistic loss is margin-based; see sample_losses
+        raise NotImplementedError("use sample_losses()/objective()")
 
-    def objective(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
+    def sample_losses(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
         m = self.margins(w, batch)
         yf = y.astype(jnp.float32)
-        losses = jnp.logaddexp(0.0, -yf * m)  # log(1 + exp(-y m)), stable
+        return jnp.logaddexp(0.0, -yf * m)  # log(1 + exp(-y m)), stable
+
+    def objective(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
         reg = self.lam * jnp.sum(w.astype(jnp.float32) ** 2)
-        return reg + jnp.mean(losses)
+        return reg + jnp.mean(self.sample_losses(w, batch, y))
 
     def grad_coeff(self, margins: jax.Array, y: jax.Array) -> jax.Array:
         yf = y.astype(jnp.float32)

@@ -1,0 +1,5 @@
+from distributed_sgd_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_dataset,
+)
+from distributed_sgd_tpu.parallel.sync import SyncEngine  # noqa: F401
