@@ -1,0 +1,307 @@
+"""Asynchronous Hogwild SGD with full-mesh delta gossip.
+
+TPU-native re-design of the reference's async mode (core/Slave.scala:79-111
++ core/MasterAsync.scala:32-177).  TPU SPMD is synchronous, so Hogwild's
+unsynchronized races cannot live *inside* one compiled program; instead the
+asynchrony lives on the host, exactly where the reference keeps it (gRPC
+threads), while each worker's compute step is a compiled device function:
+
+- worker i owns a weights replica on its own device and a resident shard
+  of the training data (vanilla contiguous assignment, as sent in
+  StartAsyncRequest, MasterAsync.scala:52-55);
+- its hot loop draws a uniform batch from the shard, computes
+  ``delta = lr * regularize(mean of backwards)`` ON DEVICE
+  (Slave.scala:93-99 — note MEAN here vs the sync mode's SUM), applies it
+  locally, and gossips the delta to every peer and the master,
+  fire-and-forget (Slave.scala:103-105);
+- all weight mutations are *delta subtractions* — commutative — so a
+  stale-snapshot step composes with concurrent incoming deltas exactly
+  like the reference's STM `transform(_ - delta)` (Slave.scala:101,180);
+- gossiped deltas cross devices through host memory (the analogue of the
+  reference's proto serialization); inboxes are bounded and drop-oldest
+  under overload — the reference's fire-and-forget gRPC likewise gives no
+  delivery guarantee — with drops counted in metrics;
+- the master counts updates, ends at ``maxSteps = n_samples * max_epochs``
+  (MasterAsync.scala:83,164-177), and a loss-checker loop evaluates the
+  smoothed test loss every `check_every` updates with 2.5 s backoff,
+  tracks best weights, and early-stops on the smoothed history
+  (MasterAsync.scala:96-162); fit returns the BEST weights, not the last
+  (MasterAsync.scala:87-94).
+
+For a fully-compiled on-mesh alternative with the same convergence family
+(local SGD + periodic averaging) see parallel/local_sgd.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sgd_tpu.core.early_stopping import Criterion
+from distributed_sgd_tpu.core.grad_state import GradState
+from distributed_sgd_tpu.core.split import vanilla_split
+from distributed_sgd_tpu.core.trainer import FitResult
+from distributed_sgd_tpu.data.rcv1 import Dataset
+from distributed_sgd_tpu.models.linear import LinearModel
+from distributed_sgd_tpu.ops.sparse import SparseBatch
+from distributed_sgd_tpu.parallel.mesh import make_mesh
+from distributed_sgd_tpu.parallel.sync import SyncEngine
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+log = logging.getLogger("dsgd.hogwild")
+
+
+class _Worker:
+    """One async worker: device-resident shard + weights replica + inbox."""
+
+    def __init__(
+        self,
+        wid: int,
+        model: LinearModel,
+        shard: Dataset,
+        device,
+        batch_size: int,
+        learning_rate: float,
+        seed: int,
+        metrics: metrics_mod.Metrics,
+        max_inbox: int = 1024,
+    ):
+        self.wid = wid
+        self.device = device
+        self.metrics = metrics
+        self.inbox: "queue.Queue[np.ndarray]" = queue.Queue(maxsize=max_inbox)
+        self._lock = threading.Lock()
+        self._running = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._key = jax.random.PRNGKey(seed + 1000 * (wid + 1))
+        self._t = 0
+
+        self._idx = jax.device_put(shard.indices, device)
+        self._val = jax.device_put(shard.values, device)
+        self._y = jax.device_put(shard.labels, device)
+        shard_n = len(shard)
+        bs = batch_size
+
+        def step(w, idx, val, y, key):
+            ids = jax.random.randint(key, (bs,), 0, shard_n)
+            batch = SparseBatch(idx[ids], val[ids])
+            g = model.grad_mean(w, batch, y[ids])  # MEAN (Slave.scala:93-98)
+            return learning_rate * model.regularize(g, w)  # Slave.scala:99
+
+        self._step = jax.jit(step)
+        self._apply = jax.jit(lambda w, d: w - d)
+        self.w: Optional[jax.Array] = None
+        self._peers: List["_Worker"] = []
+        self._master: Optional["HogwildEngine"] = None
+
+    # -- wiring ------------------------------------------------------------
+    def connect(self, peers: List["_Worker"], master: "HogwildEngine") -> None:
+        self._peers = [p for p in peers if p.wid != self.wid]
+        self._master = master
+
+    # -- RPC-equivalent surface (Slave service, proto.proto:37-49) ---------
+    def push_delta(self, delta: np.ndarray) -> None:
+        """Peer updateGrad (Slave.scala:177-185): fire-and-forget inbox."""
+        try:
+            self.inbox.put_nowait(delta)
+        except queue.Full:
+            try:  # drop-oldest under overload; counted, not silent
+                self.inbox.get_nowait()
+                self.inbox.put_nowait(delta)
+            except queue.Empty:
+                pass
+            self.metrics.counter("slave.async.grad.dropped").increment()
+
+    def start_async(self, w0: np.ndarray) -> None:
+        """StartAsync RPC (Slave.scala:159-175)."""
+        self.w = jax.device_put(jnp.asarray(w0, dtype=jnp.float32), self.device)
+        self._running.set()
+        self._thread = threading.Thread(target=self._loop, name=f"hogwild-{self.wid}", daemon=True)
+        self._thread.start()
+
+    def stop_async(self) -> None:
+        """StopAsync RPC (Slave.scala:187-195)."""
+        self._running.clear()
+
+    def join(self) -> None:
+        if self._thread is not None:
+            self._thread.join()
+
+    # -- hot loop (Slave.asyncTask, Slave.scala:79-111) --------------------
+    def _drain_inbox(self) -> None:
+        while True:
+            try:
+                d = self.inbox.get_nowait()
+            except queue.Empty:
+                return
+            with self._lock:
+                self.w = self._apply(self.w, jnp.asarray(d))
+            self.metrics.counter("slave.async.grad.update").increment()
+
+    def _loop(self) -> None:
+        while self._running.is_set():
+            self._drain_inbox()
+            self._key, k = jax.random.split(self._key)
+            snapshot = self.w  # stale-read is the algorithm (Hogwild)
+            delta = self._step(snapshot, self._idx, self._val, self._y, k)
+            with self._lock:
+                self.w = self._apply(self.w, delta)
+            self.metrics.counter("slave.async.batch").increment()
+            delta_np = np.asarray(delta)  # host hop = the wire serialization
+            for peer in self._peers:
+                peer.push_delta(delta_np)
+            if self._master is not None:
+                self._master._update_grad(delta_np)
+            self._t += 1
+
+
+class HogwildEngine:
+    """Coordinator: spawns workers, counts updates, checks smoothed loss."""
+
+    def __init__(
+        self,
+        model: LinearModel,
+        n_workers: int,
+        batch_size: int,
+        learning_rate: float,
+        check_every: int = 100,
+        leaky_loss: float = 0.9,
+        backoff_s: float = 2.5,
+        devices=None,
+        seed: int = 0,
+        metrics: Optional[metrics_mod.Metrics] = None,
+    ):
+        if not (0.0 <= leaky_loss <= 1.0):
+            raise ValueError("leaking coefficient must be between 0 and 1")
+        self.model = model
+        self.n_workers = n_workers
+        self.batch_size = batch_size
+        self.learning_rate = learning_rate
+        self.check_every = check_every
+        self.leaky_loss = leaky_loss
+        self.backoff_s = backoff_s
+        self.seed = seed
+        self.metrics = metrics or metrics_mod.global_metrics()
+        devs = list(devices if devices is not None else jax.devices())
+        # round-robin device assignment; >1 worker may share a chip
+        self.devices = [devs[i % len(devs)] for i in range(n_workers)]
+
+        self._lock = threading.Lock()
+        self._updates = 0
+        self._w_master: Optional[jax.Array] = None
+        self._apply = jax.jit(lambda w, d: w - d)
+        self._stop = threading.Event()
+        self._max_steps = 0
+
+    # master updateGrad RPC (MasterAsync.scala:164-177)
+    def _update_grad(self, delta: np.ndarray) -> None:
+        with self._lock:
+            self._w_master = self._apply(self._w_master, jnp.asarray(delta))
+            self._updates += 1
+            updates = self._updates
+        if updates % 1000 == 0:
+            log.info("%d updates received", updates)
+        if updates >= self._max_steps:
+            self._stop.set()
+
+    def fit(
+        self,
+        train: Dataset,
+        test: Dataset,
+        max_epochs: int,
+        criterion: Optional[Criterion] = None,
+        initial_weights: Optional[np.ndarray] = None,
+    ) -> FitResult:
+        n = len(train)
+        w0 = (
+            np.zeros(self.model.n_features, dtype=np.float32)
+            if initial_weights is None
+            else np.asarray(initial_weights, dtype=np.float32)
+        )
+        self._w_master = jnp.asarray(w0)
+        self._updates = 0
+        self._max_steps = n * max_epochs  # MasterAsync.scala:83
+        self._stop.clear()
+
+        # contiguous shard assignment, as the reference's vanilla split
+        splits = vanilla_split(n, self.n_workers)
+        workers = [
+            _Worker(
+                i,
+                self.model,
+                train.slice(splits[i]),
+                self.devices[i],
+                self.batch_size,
+                self.learning_rate,
+                self.seed,
+                self.metrics,
+            )
+            for i in range(self.n_workers)
+        ]
+        for w in workers:
+            w.connect(workers, self)
+
+        # master-local test eval (the loss checker's localLoss equivalent)
+        eval_bound = SyncEngine(self.model, make_mesh(1), self.batch_size, 0.0).bind(test)
+
+        result = FitResult(state=GradState(weights=self._w_master))
+        best_loss = float("inf")
+        best_w = w0
+        smoothed_hist: List[float] = []  # newest first
+        t_start = time.time()
+
+        for w in workers:
+            w.start_async(w0)
+
+        last_step = -self.check_every  # first check runs immediately
+        try:
+            while not self._stop.is_set():
+                with self._lock:
+                    updates = self._updates
+                    w_now = self._w_master
+                if updates - last_step < self.check_every:
+                    self._stop.wait(self.backoff_s)
+                    continue
+                raw_loss, raw_acc = eval_bound.evaluate(w_now)
+                prev = smoothed_hist[0] if smoothed_hist else raw_loss
+                loss = self.leaky_loss * raw_loss + (1 - self.leaky_loss) * prev
+                prev_acc = result.test_accuracies[-1] if result.test_accuracies else raw_acc
+                acc = self.leaky_loss * raw_acc + (1 - self.leaky_loss) * prev_acc
+                smoothed_hist.insert(0, loss)
+                result.test_losses.append(loss)
+                result.test_accuracies.append(acc)
+                self.metrics.counter("master.async.loss").increment(int(loss))
+                log.info(
+                    "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
+                    updates, loss, acc,
+                )
+                if loss < best_loss:  # best-so-far (MasterAsync.scala:130-139)
+                    best_loss = loss
+                    best_w = np.asarray(w_now)
+                    log.info("best loss so far!")
+                last_step = updates
+                if criterion is not None and criterion(smoothed_hist):
+                    log.info("converged to target: stopping computation")
+                    self._stop.set()
+        finally:
+            for w in workers:
+                w.stop_async()
+            for w in workers:
+                w.join()
+
+        # return BEST weights (MasterAsync.scala:87-94)
+        result.state = GradState(
+            weights=jnp.asarray(best_w),
+            loss=best_loss if best_loss != float("inf") else float("nan"),
+            start=t_start,
+            updates=self._updates,
+        ).finish()
+        result.epochs_run = self._updates * self.batch_size // max(n, 1)
+        return result

@@ -1,0 +1,159 @@
+"""On-mesh local SGD with periodic averaging — the compiled async mode.
+
+The reference's Hogwild gossip (Slave.scala:79-111) is host-asynchronous by
+nature; parallel/hogwild.py reproduces it faithfully.  This module is the
+TPU-idiomatic alternative in the same convergence family (local update
+steps on stale replicas + delta exchange): every device runs ``sync_period``
+independent SGD steps on its own weights replica — the compiled analogue of
+Hogwild's stale local loop — then replicas average over the ICI mesh with
+one ``pmean`` (the all-to-all gossip collapsed into a collective).  The
+entire round is one compiled program; no host participation, no
+serialization, no queues.  Offered behind ``Config.async_mode='local_sgd'``
+(SURVEY.md §7 step 6's "alternative to offer behind config").
+
+The host loop around rounds reuses the reference's async loss-checker
+semantics: leaky-smoothed test loss, best-weights tracking, early stop on
+the smoothed history, total update budget n_samples * max_epochs
+(MasterAsync.scala:83,96-162).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_sgd_tpu.core.early_stopping import Criterion
+from distributed_sgd_tpu.core.grad_state import GradState
+from distributed_sgd_tpu.core.trainer import FitResult
+from distributed_sgd_tpu.data.rcv1 import Dataset
+from distributed_sgd_tpu.models.linear import LinearModel
+from distributed_sgd_tpu.ops.sparse import SparseBatch
+from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS as AXIS
+from distributed_sgd_tpu.parallel.sync import SyncEngine
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+log = logging.getLogger("dsgd.local_sgd")
+
+
+class LocalSGDEngine:
+    def __init__(
+        self,
+        model: LinearModel,
+        mesh,
+        batch_size: int,
+        learning_rate: float,
+        sync_period: int = 16,
+        check_every: int = 100,
+        leaky_loss: float = 0.9,
+        seed: int = 0,
+        metrics: Optional[metrics_mod.Metrics] = None,
+    ):
+        if not (0.0 <= leaky_loss <= 1.0):
+            raise ValueError("leaking coefficient must be between 0 and 1")
+        self.model = model
+        self.mesh = mesh
+        self.batch_size = int(batch_size)
+        self.learning_rate = float(learning_rate)
+        self.sync_period = int(sync_period)
+        self.check_every = check_every
+        self.leaky_loss = leaky_loss
+        self.seed = seed
+        self.metrics = metrics or metrics_mod.global_metrics()
+        self.n_workers = mesh.shape[AXIS]
+
+    def fit(
+        self,
+        train: Dataset,
+        test: Dataset,
+        max_epochs: int,
+        criterion: Optional[Criterion] = None,
+        initial_weights: Optional[np.ndarray] = None,
+    ) -> FitResult:
+        engine = SyncEngine(self.model, self.mesh, self.batch_size, self.learning_rate)
+        bound = engine.bind(train)  # reuse dataset sharding + eval/compile plumbing
+        eval_bound = engine.bind(test)
+        data = bound.data
+        shard_n = bound.shard_n
+        bs, lr, h = self.batch_size, self.learning_rate, self.sync_period
+        model = self.model
+
+        def round_shard(w, idx, val, y, key):
+            key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
+
+            def body(wl, t):
+                ids = jax.random.randint(jax.random.fold_in(key, t), (bs,), 0, shard_n)
+                batch = SparseBatch(idx[ids], val[ids])
+                g = model.grad_mean(wl, batch, y[ids])
+                return wl - lr * model.regularize(g, wl), ()
+
+            w_var = jax.lax.pcast(w, (AXIS,), to="varying")  # replicas diverge
+            wl, _ = jax.lax.scan(body, w_var, jnp.arange(h))
+            return jax.lax.pmean(wl, AXIS)  # the gossip, collapsed
+
+        round_fn = jax.jit(
+            jax.shard_map(
+                round_shard,
+                mesh=self.mesh,
+                in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P()),
+                out_specs=P(),
+            )
+        )
+
+        n = len(train)
+        max_steps = n * max_epochs  # MasterAsync.scala:83
+        w = (
+            jnp.zeros(self.model.n_features, dtype=jnp.float32)
+            if initial_weights is None
+            else jnp.asarray(initial_weights, dtype=jnp.float32)
+        )
+        key = jax.random.PRNGKey(self.seed)
+        result = FitResult(state=GradState(weights=w))
+        smoothed: List[float] = []  # newest first
+        best_loss, best_w = float("inf"), np.asarray(w)
+        steps_done, last_check = 0, -self.check_every
+        t_start = time.time()
+
+        while steps_done < max_steps:
+            key, rk = jax.random.split(key)
+            t0 = time.perf_counter()
+            w = round_fn(w, data.indices, data.values, data.labels, rk)
+            jax.block_until_ready(w)
+            self.metrics.histogram("slave.async.round.seconds").record(
+                time.perf_counter() - t0
+            )
+            steps_done += self.n_workers * h
+            if steps_done - last_check < self.check_every:
+                continue
+            raw_loss, raw_acc = eval_bound.evaluate(w)
+            prev = smoothed[0] if smoothed else raw_loss
+            loss = self.leaky_loss * raw_loss + (1 - self.leaky_loss) * prev
+            prev_acc = result.test_accuracies[-1] if result.test_accuracies else raw_acc
+            acc = self.leaky_loss * raw_acc + (1 - self.leaky_loss) * prev_acc
+            smoothed.insert(0, loss)
+            result.test_losses.append(loss)
+            result.test_accuracies.append(acc)
+            log.info(
+                "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
+                steps_done, loss, acc,
+            )
+            if loss < best_loss:
+                best_loss, best_w = loss, np.asarray(w)
+            last_check = steps_done
+            if criterion is not None and criterion(smoothed):
+                log.info("converged to target: stopping computation")
+                break
+
+        result.state = GradState(
+            weights=jnp.asarray(best_w),
+            loss=best_loss if best_loss != float("inf") else float("nan"),
+            start=t_start,
+            updates=steps_done,
+        ).finish()
+        result.epochs_run = steps_done * self.batch_size // max(n, 1)
+        return result

@@ -105,8 +105,9 @@ def test_predict_returns_reference_predictions():
 
 @pytest.mark.parametrize("sampling", ["fresh", "epoch"])
 def test_trainer_converges_multi_worker(sampling):
-    train = rcv1_like(512, n_features=256, nnz=12, noise=0.0, seed=5)
-    test = rcv1_like(128, n_features=256, nnz=12, noise=0.0, seed=6)
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+    train, test = train_test_split(rcv1_like(640, n_features=256, nnz=12, noise=0.0, seed=5))
     mesh = make_mesh(8)
     # logistic has informative gradients on this tiny problem
     from distributed_sgd_tpu.models.linear import LogisticRegression
@@ -120,8 +121,9 @@ def test_trainer_converges_multi_worker(sampling):
 
 
 def test_trainer_early_stops_on_test_losses():
-    train = rcv1_like(256, n_features=128, nnz=8, noise=0.0, seed=7)
-    test = rcv1_like(64, n_features=128, nnz=8, noise=0.0, seed=8)
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+    train, test = train_test_split(rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=7))
     mesh = make_mesh(2)
     model = SparseSVM(lam=0.0, n_features=128, regularizer="none")
     # learning_rate=0 -> constant losses -> no-improvement fires at patience
@@ -134,8 +136,9 @@ def test_worker_count_equivalence_single_vs_mesh():
     """grad mean over k workers each summing bs samples == the same total
     sample set on 1 worker scaled by bs*k/k... sanity: loss decreases on
     both and final losses are in the same ballpark."""
-    train = rcv1_like(256, n_features=128, nnz=8, noise=0.0, seed=9)
-    test = rcv1_like(64, n_features=128, nnz=8, noise=0.0, seed=10)
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+    train, test = train_test_split(rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=9))
     from distributed_sgd_tpu.models.linear import LogisticRegression
 
     finals = []
