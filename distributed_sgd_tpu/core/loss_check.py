@@ -1,0 +1,50 @@
+"""Shared async loss-checker state: leaky smoothing + best-weights tracking.
+
+Factors the reference's loss-checking loop state (MasterAsync.scala:96-162)
+used by all three async drivers (gRPC master, in-process Hogwild, on-mesh
+local SGD): smoothed_t = c * raw + (1 - c) * smoothed_{t-1} (first check
+uses raw as prev), newest-first smoothed history for the stopping
+criterion, and best-(loss, weights) snapshotting.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from distributed_sgd_tpu.core.early_stopping import Criterion
+
+
+class LossChecker:
+    def __init__(self, leaky_loss: float, criterion: Optional[Criterion] = None):
+        if not (0.0 <= leaky_loss <= 1.0):
+            raise ValueError("leaking coefficient must be between 0 and 1")
+        self.leaky = leaky_loss
+        self.criterion = criterion
+        self.smoothed: List[float] = []  # newest first
+        self.smoothed_accs: List[float] = []  # newest first
+        self.best_loss = float("inf")
+        self.best_weights: Optional[np.ndarray] = None
+
+    def check(self, raw_loss: float, raw_acc: float, weights) -> bool:
+        """Record one evaluation; returns True if training should stop."""
+        prev = self.smoothed[0] if self.smoothed else raw_loss
+        loss = self.leaky * raw_loss + (1 - self.leaky) * prev
+        prev_acc = self.smoothed_accs[0] if self.smoothed_accs else raw_acc
+        acc = self.leaky * raw_acc + (1 - self.leaky) * prev_acc
+        self.smoothed.insert(0, loss)
+        self.smoothed_accs.insert(0, acc)
+        if loss < self.best_loss:  # MasterAsync.scala:130-139
+            self.best_loss = loss
+            self.best_weights = np.asarray(weights)
+        return self.criterion is not None and self.criterion(self.smoothed)
+
+    @property
+    def history(self) -> List[float]:
+        """Chronological smoothed losses."""
+        return list(reversed(self.smoothed))
+
+    @property
+    def acc_history(self) -> List[float]:
+        return list(reversed(self.smoothed_accs))

@@ -1,0 +1,395 @@
+"""Master node: cluster membership, readiness barrier, distributed fits.
+
+TPU-native re-design of the reference master (core/Master.scala,
+core/MasterSync.scala, core/MasterAsync.scala).  The control plane is
+preserved structurally — registration with full-mesh peer introduction
+(Master.scala:222-243), readiness barrier gating all work
+(Master.scala:34-59), unregister broadcast (Master.scala:245-253), the
+sync per-batch fan-out/barrier/mean loop (Master.scala:120-218), the async
+StartAsync fan-out + update counting + loss checker (MasterAsync.scala) —
+while all local evaluation runs compiled on the master's device and worker
+gradient computation runs compiled on theirs.
+
+This RPC mode exists for reference-parity cluster topology and cross-host
+deployments WITHOUT a shared jax mesh; when all devices live in one
+process/slice, parallel/sync.py's in-mesh engine is the fast path (no
+weight serialization at all).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import grpc
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sgd_tpu.core.early_stopping import Criterion
+from distributed_sgd_tpu.core.grad_state import GradState
+from distributed_sgd_tpu.core.loss_check import LossChecker
+from distributed_sgd_tpu.core.split import vanilla_split
+from distributed_sgd_tpu.core.trainer import FitResult
+from distributed_sgd_tpu.data.rcv1 import Dataset
+from distributed_sgd_tpu.models.linear import LinearModel
+from distributed_sgd_tpu.parallel.mesh import make_mesh
+from distributed_sgd_tpu.parallel.sync import SyncEngine
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import (
+    WorkerStub,
+    add_master_servicer,
+    new_channel,
+    new_server,
+)
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+from distributed_sgd_tpu.utils.log import node_logger
+
+SplitFn = Callable[[int, int], List[np.ndarray]]
+
+
+class MasterNode:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        train: Dataset,
+        test: Dataset,
+        model: LinearModel,
+        expected_workers: int,
+        seed: int = 0,
+        metrics: Optional[metrics_mod.Metrics] = None,
+    ):
+        self.host, self.port = host, port
+        self.log = node_logger(host, port, master=True)
+        self.metrics = metrics or metrics_mod.global_metrics()
+        self.model = model
+        self.train = train
+        self.test = test
+        self.expected_workers = expected_workers
+        self.seed = seed
+        self._rng = np.random.default_rng(seed)
+
+        self._workers: Dict[Tuple[str, int], WorkerStub] = {}
+        self._channels: Dict[Tuple[str, int], grpc.Channel] = {}
+        self._order: List[Tuple[str, int]] = []  # registration order
+        self._members_lock = threading.Lock()
+        self.cluster_ready = threading.Event()  # Master.scala:34-35
+
+        # master-local eval (Master.localLoss/localAccuracy) on this device
+        engine = SyncEngine(model, make_mesh(1), batch_size=1, learning_rate=0.0)
+        self._eval_train = engine.bind(train)
+        self._eval_test = engine.bind(test)
+
+        # async state (AsyncMasterGrpcImpl)
+        self._async_lock = threading.Lock()
+        self._w_async: Optional[jax.Array] = None
+        self._updates = 0
+        self._max_steps = 0
+        self._async_running = threading.Event()
+        self._apply = jax.jit(lambda w, d: w - d)
+
+        self.server = new_server(port, host="0.0.0.0")
+        self.port = self.port or self.server.bound_port
+        add_master_servicer(self.server, _MasterServicer(self))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "MasterNode":
+        self.server.start()
+        self.log.info("master started on %s:%d, expecting %d workers",
+                      self.host, self.port, self.expected_workers)
+        return self
+
+    def stop(self) -> None:
+        self._async_running.clear()
+        self.server.stop(grace=1.0)
+        for ch in self._channels.values():
+            ch.close()
+        self.log.info("master stopped")
+
+    def await_ready(self, timeout: Optional[float] = None) -> bool:
+        return self.cluster_ready.wait(timeout)
+
+    # -- membership (Master.scala:222-253) ---------------------------------
+
+    def register_worker(self, host: str, port: int) -> None:
+        key = (host, port)
+        with self._members_lock:
+            if key in self._workers:
+                return
+            if len(self._workers) >= self.expected_workers:
+                # the reference `require`s joins <= expected (Master.scala:224)
+                raise ValueError("cluster already at expected node count")
+            others = list(self._workers.keys())
+            ch = new_channel(host, port)
+            stub = WorkerStub(ch)
+            self._workers[key] = stub
+            self._channels[key] = ch
+            self._order.append(key)
+            count = len(self._workers)
+        self.log.info("worker registered: %s:%d (%d/%d)",
+                      host, port, count, self.expected_workers)
+        # full-mesh introduction, both directions (Master.scala:229-233)
+        new_node = pb.Node(host=host, port=port)
+        for oh, op in others:
+            try:
+                self._workers[(oh, op)].RegisterSlave(new_node, timeout=5.0)
+                stub.RegisterSlave(pb.Node(host=oh, port=op), timeout=5.0)
+            except grpc.RpcError as e:
+                self.log.warning("peer introduction failed for %s:%d (%s)", oh, op, e.code())
+        if count >= self.expected_workers:
+            self.cluster_ready.set()  # Master.scala:235-241
+
+    def unregister_worker(self, host: str, port: int) -> None:
+        key = (host, port)
+        with self._members_lock:
+            self._workers.pop(key, None)
+            ch = self._channels.pop(key, None)
+            if key in self._order:
+                self._order.remove(key)
+            remaining = list(self._workers.values())
+        if ch is not None:
+            ch.close()
+        node = pb.Node(host=host, port=port)
+        for stub in remaining:  # broadcast (Master.scala:245-253)
+            try:
+                stub.UnregisterSlave(node, timeout=5.0)
+            except grpc.RpcError:
+                pass
+        self.log.info("worker unregistered: %s:%d", host, port)
+
+    def _stubs(self) -> List[WorkerStub]:
+        with self._members_lock:
+            return [self._workers[k] for k in self._order]
+
+    # -- distributed eval (Master.scala:61-98) -----------------------------
+
+    def predict(self, weights: np.ndarray, split: SplitFn = vanilla_split) -> np.ndarray:
+        """Fan ForwardRequests out to every worker; gather predictions."""
+        self._require_ready()
+        stubs = self._stubs()
+        parts = split(len(self.train), len(stubs))
+        wmsg = codec.encode_tensor(weights)
+        futs = [
+            stub.Forward.future(pb.ForwardRequest(samples=ids.astype(np.int32), weights=wmsg))
+            for stub, ids in zip(stubs, parts)
+        ]
+        out = np.zeros(len(self.train), dtype=np.float32)
+        for ids, fut in zip(parts, futs):
+            reply = fut.result()
+            out[ids] = np.fromiter(reply.predictions, dtype=np.float32)
+        return out
+
+    def distributed_loss(self, weights: np.ndarray) -> float:
+        preds = self.predict(weights)
+        y = self.train.labels
+        sample = np.asarray(
+            self.model.sample_loss(jnp.asarray(preds), jnp.asarray(y))
+        )
+        reg = self.model.lam * float(np.dot(weights, weights))
+        return reg + float(sample.mean())
+
+    def distributed_accuracy(self, weights: np.ndarray) -> float:
+        preds = self.predict(weights)
+        return float((preds == self.train.labels).mean())
+
+    def local_loss(self, weights, test: bool = False) -> Tuple[float, float]:
+        bound = self._eval_test if test else self._eval_train
+        return bound.evaluate(jnp.asarray(weights, dtype=jnp.float32))
+
+    # -- sync fit (Master.scala:120-218) -----------------------------------
+
+    def fit_sync(
+        self,
+        max_epochs: int,
+        batch_size: int,
+        learning_rate: float,
+        criterion: Optional[Criterion] = None,
+        split: SplitFn = vanilla_split,
+        initial_weights: Optional[np.ndarray] = None,
+    ) -> FitResult:
+        self._require_ready()
+        stubs = self._stubs()
+        parts = split(len(self.train), len(stubs))
+        max_samples = max(len(p) for p in parts)
+        w = (
+            np.zeros(self.model.n_features, dtype=np.float32)
+            if initial_weights is None
+            else np.asarray(initial_weights, dtype=np.float32)
+        )
+        result = FitResult(state=GradState(weights=w))
+        test_newest_first: List[float] = []
+
+        for epoch in range(max_epochs):
+            t0 = time.perf_counter()
+            for batch in range(0, max_samples, batch_size):
+                with self.metrics.timer("master.sync.batch.duration"):
+                    wmsg = codec.encode_tensor(w)
+                    futs = []
+                    for stub, part in zip(stubs, parts):
+                        shuffled = self._rng.permutation(part)  # Master.scala:184
+                        ids = shuffled[batch : batch + batch_size]
+                        futs.append(
+                            stub.Gradient.future(
+                                pb.GradientRequest(weights=wmsg, samples=ids.astype(np.int32))
+                            )
+                        )
+                    grads = [codec.decode_grad(f.result()) for f in futs]  # barrier
+                    grad = np.mean(grads, axis=0)  # Vec.mean (Master.scala:194)
+                    w = w - learning_rate * grad
+            epoch_s = time.perf_counter() - t0
+
+            loss, acc = self.local_loss(w)
+            test_loss, test_acc = self.local_loss(w, test=True)
+            result.losses.append(loss)
+            result.accuracies.append(acc)
+            result.test_losses.append(test_loss)
+            result.test_accuracies.append(test_acc)
+            result.epoch_seconds.append(epoch_s)
+            result.epochs_run = epoch + 1
+            test_newest_first.insert(0, test_loss)
+            self.metrics.histogram("master.sync.loss").record(loss)
+            self.metrics.histogram("master.sync.acc").record(100 * acc)
+            self.log.info(
+                "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
+                epoch, loss, acc, test_loss, test_acc, epoch_s,
+            )
+            if criterion is not None and criterion(test_newest_first):
+                self.log.info("Converged to target: stopping computation")
+                break
+
+        result.state = GradState(
+            weights=w, loss=result.losses[-1] if result.losses else float("nan")
+        ).finish()
+        return result
+
+    # -- async fit (MasterAsync.scala:32-162) ------------------------------
+
+    def fit_async(
+        self,
+        max_epochs: int,
+        batch_size: int,
+        learning_rate: float,
+        criterion: Optional[Criterion] = None,
+        check_every: int = 100,
+        leaky_loss: float = 0.9,
+        backoff_s: float = 2.5,
+        split: SplitFn = vanilla_split,
+        initial_weights: Optional[np.ndarray] = None,
+    ) -> FitResult:
+        self._require_ready()
+        if self._async_running.is_set():
+            raise RuntimeError("a computation is already running")  # MasterAsync.scala:42
+        stubs = self._stubs()
+        parts = split(len(self.train), len(stubs))
+        w0 = (
+            np.zeros(self.model.n_features, dtype=np.float32)
+            if initial_weights is None
+            else np.asarray(initial_weights, dtype=np.float32)
+        )
+        with self._async_lock:
+            self._w_async = jnp.asarray(w0)
+            self._updates = 0
+            self._max_steps = len(self.train) * max_epochs  # MasterAsync.scala:83
+        self._async_running.set()
+        t_start = time.time()
+
+        wmsg = codec.encode_tensor(w0)
+        for stub, part in zip(stubs, parts):  # MasterAsync.scala:52-55
+            stub.StartAsync(
+                pb.StartAsyncRequest(
+                    weights=wmsg,
+                    samples=part.astype(np.int32),
+                    batch_size=batch_size,
+                    learning_rate=learning_rate,
+                ),
+                timeout=10.0,
+            )
+        self.log.info("waiting for slaves updates")
+
+        checker = LossChecker(leaky_loss, criterion)
+        result = FitResult(state=GradState(weights=w0))
+        last_step = -check_every
+        while self._async_running.is_set():
+            with self._async_lock:
+                updates = self._updates
+                w_now = self._w_async
+            if updates - last_step < check_every:
+                self._async_running.wait(backoff_s)
+                continue
+            raw_loss, raw_acc = self.local_loss(w_now, test=True)
+            stop = checker.check(raw_loss, raw_acc, w_now)
+            self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
+            self.log.info(
+                "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
+                updates, checker.smoothed[0], checker.smoothed_accs[0],
+            )
+            last_step = updates
+            if stop:
+                self.log.info("converged to target: stopping computation")
+                break
+
+        self._end_async(stubs)
+        result.test_losses = checker.history
+        result.test_accuracies = checker.acc_history
+        best_w = checker.best_weights if checker.best_weights is not None else w0
+        result.state = GradState(  # BEST weights (MasterAsync.scala:87-94)
+            weights=jnp.asarray(best_w),
+            loss=checker.best_loss if checker.best_loss != float("inf") else float("nan"),
+            start=t_start,
+            updates=self._updates,
+        ).finish()
+        result.epochs_run = self._updates * batch_size // max(len(self.train), 1)
+        return result
+
+    def _end_async(self, stubs) -> None:
+        self._async_running.clear()
+        for stub in stubs:  # broadcast stopAsync (MasterAsync.scala:87-94)
+            try:
+                stub.StopAsync(pb.Empty(), timeout=5.0)
+            except grpc.RpcError:
+                pass
+
+    # master UpdateGrad RPC (MasterAsync.scala:164-177)
+    def _update_grad(self, delta: np.ndarray) -> None:
+        with self._async_lock:
+            if self._w_async is None:
+                return
+            self._w_async = self._apply(self._w_async, jnp.asarray(delta))
+            self._updates += 1
+            updates = self._updates
+        if updates % 1000 == 0:
+            self.log.info("%d updates received", updates)
+        if updates >= self._max_steps and self._async_running.is_set():
+            self.log.info("max number of steps reached: stopping computation")
+            self._async_running.clear()
+
+    def _require_ready(self) -> None:
+        if not self.cluster_ready.is_set():  # withClusterReady barrier
+            self.log.info("waiting for %d workers to join", self.expected_workers)
+            self.cluster_ready.wait()
+
+
+class _MasterServicer:
+    """gRPC method bodies (AbstractMasterGrpc, Master.scala:220-253)."""
+
+    def __init__(self, m: MasterNode):
+        self.m = m
+
+    def RegisterSlave(self, request, context):  # noqa: N802
+        try:
+            self.m.register_worker(request.host, request.port)
+        except ValueError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return pb.Ack()
+
+    def UnregisterSlave(self, request, context):  # noqa: N802
+        self.m.unregister_worker(request.host, request.port)
+        return pb.Ack()
+
+    def UpdateGrad(self, request, context):  # noqa: N802
+        self.m._update_grad(codec.decode_grad(request))
+        return pb.Ack()

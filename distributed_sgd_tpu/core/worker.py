@@ -1,0 +1,304 @@
+"""Worker node: gRPC server + device-resident data + compiled kernels.
+
+TPU-native re-design of the reference's Slave (core/Slave.scala): the
+process boundary, registration retry, peer bookkeeping, and the async
+gossip loop survive as host-side control plane, while every computation a
+slave performs — per-sample forward (Slave.scala:129-140), batch gradient
+sum + regularize (Slave.scala:142-157), and the Hogwild local step
+(Slave.scala:79-111) — runs as a jitted XLA program on this worker's
+device over a device-resident copy of the training data.
+
+Variable-length RPC sample lists are padded to power-of-two buckets with
+zeroed feature values (a zero row contributes zero gradient in every
+model), so each bucket size compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import grpc
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sgd_tpu.data.rcv1 import Dataset
+from distributed_sgd_tpu.models.linear import LinearModel
+from distributed_sgd_tpu.ops.sparse import SparseBatch
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import (
+    MasterStub,
+    WorkerStub,
+    add_worker_servicer,
+    new_channel,
+    new_server,
+)
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+from distributed_sgd_tpu.utils.log import node_logger
+
+REGISTER_RETRY_S = 2.0  # Slave.scala:56
+REGISTER_DEADLINE_S = 5.0  # Slave.scala:48
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+class WorkerNode:
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        master_host: str,
+        master_port: int,
+        data: Dataset,
+        model: LinearModel,
+        device=None,
+        seed: int = 0,
+        metrics: Optional[metrics_mod.Metrics] = None,
+    ):
+        self.host, self.port = host, port
+        self.log = node_logger(host, port, master=False)
+        self.metrics = metrics or metrics_mod.global_metrics()
+        self.model = model
+        self.device = device if device is not None else jax.devices()[0]
+        self.seed = seed
+
+        # device-resident copy of the full dataset (the reference slave also
+        # holds the full data and receives sample indices, Main.scala:138)
+        self._idx = jax.device_put(data.indices, self.device)
+        self._val = jax.device_put(data.values, self.device)
+        self._y = jax.device_put(data.labels, self.device)
+        self._n = len(data)
+
+        self._peers: Dict[Tuple[str, int], WorkerStub] = {}
+        self._peers_lock = threading.Lock()
+        self._master_channel = new_channel(master_host, master_port)
+        self._master = MasterStub(self._master_channel)
+
+        # async (Hogwild) state — Slave.scala:23-34
+        self._w_lock = threading.Lock()
+        self._w: Optional[jax.Array] = None
+        self._running_async = threading.Event()
+        self._async_thread: Optional[threading.Thread] = None
+        self._assignment: Optional[jax.Array] = None
+        self._async_bs = 0
+        self._async_lr = 0.0
+
+        self._apply = jax.jit(lambda w, d: w - d)
+        self._grad_cache: Dict[Tuple[int, str], callable] = {}
+
+        self.server = new_server(port, host="0.0.0.0")
+        self.port = self.port or self.server.bound_port
+        add_worker_servicer(self.server, _WorkerServicer(self))
+        self._registered = threading.Event()
+        self._stopped = threading.Event()
+
+    # -- lifecycle (Slave.scala:40-77) -------------------------------------
+
+    def start(self, wait_registered: bool = True) -> "WorkerNode":
+        self.server.start()
+        self.log.info("worker started on %s:%d", self.host, self.port)
+        t = threading.Thread(target=self._register_loop, daemon=True, name="register")
+        t.start()
+        if wait_registered:
+            self._registered.wait()
+        return self
+
+    def _register_loop(self) -> None:
+        node = pb.Node(host=self.host, port=self.port)
+        while not self._stopped.is_set() and not self._registered.is_set():
+            try:
+                self._master.RegisterSlave(node, timeout=REGISTER_DEADLINE_S)
+                self._registered.set()
+                self.log.info("registered with master")
+                return
+            except grpc.RpcError as e:
+                self.log.info("registration failed (%s); retrying in %.0fs",
+                              e.code(), REGISTER_RETRY_S)
+                self._stopped.wait(REGISTER_RETRY_S)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        self._running_async.clear()
+        if self._async_thread is not None:
+            self._async_thread.join()
+        if self._registered.is_set():
+            try:
+                self._master.UnregisterSlave(
+                    pb.Node(host=self.host, port=self.port), timeout=2.0
+                )
+            except grpc.RpcError:
+                pass
+        self.server.stop(grace=1.0)
+        self._master_channel.close()
+        self.log.info("worker stopped")
+
+    def await_termination(self) -> None:
+        self.server.wait_for_termination()
+
+    # -- peer management ---------------------------------------------------
+
+    def add_peer(self, host: str, port: int) -> None:
+        key = (host, port)
+        if key == (self.host, self.port):
+            return
+        with self._peers_lock:
+            if key not in self._peers:
+                self._peers[key] = WorkerStub(new_channel(host, port))
+                self.log.info("peer added: %s:%d", host, port)
+
+    def remove_peer(self, host: str, port: int) -> None:
+        with self._peers_lock:
+            self._peers.pop((host, port), None)
+
+    # -- compiled kernels --------------------------------------------------
+
+    def _grad_fn(self, capacity: int, kind: str):
+        """kind: 'sum' (sync Gradient RPC) or 'mean' (async step)."""
+        model = self.model
+        key = (capacity, kind)
+        if key not in self._grad_cache:
+
+            def fn(w, idx, val, y, ids, valid):
+                rows_i = idx[ids]
+                rows_v = val[ids] * valid[:, None]  # zero rows for pads
+                batch = SparseBatch(rows_i, rows_v)
+                by = y[ids] * valid.astype(y.dtype)
+                g = model.grad_sum(w, batch, by)
+                if kind == "mean":
+                    g = g / jnp.maximum(jnp.sum(valid), 1.0)
+                return model.regularize(g, w)
+
+            self._grad_cache[key] = jax.jit(fn)
+        return self._grad_cache[key]
+
+    def _pad_ids(self, ids: np.ndarray) -> Tuple[jax.Array, jax.Array]:
+        cap = _next_pow2(len(ids))
+        padded = np.zeros(cap, dtype=np.int32)
+        padded[: len(ids)] = ids
+        valid = np.zeros(cap, dtype=np.float32)
+        valid[: len(ids)] = 1.0
+        return jnp.asarray(padded), jnp.asarray(valid)
+
+    def compute_gradient(self, w: np.ndarray, ids: np.ndarray) -> np.ndarray:
+        """Sync Gradient RPC body: sum of backwards + regularize
+        (Slave.scala:142-157)."""
+        pids, valid = self._pad_ids(ids)
+        g = self._grad_fn(len(pids), "sum")(
+            jnp.asarray(w), self._idx, self._val, self._y, pids, valid
+        )
+        self.metrics.counter("slave.sync.backward").increment()
+        return np.asarray(g)
+
+    def compute_forward(self, w: np.ndarray, ids: np.ndarray) -> np.ndarray:
+        """Forward RPC body (Slave.scala:129-140)."""
+        pids, _ = self._pad_ids(ids)
+        wj = jnp.asarray(w)
+        batch = SparseBatch(self._idx[pids], self._val[pids])
+        preds = self.model.forward(wj, batch)
+        self.metrics.counter("slave.sync.forward").increment()
+        return np.asarray(preds)[: len(ids)]
+
+    # -- async engine (Slave.scala:79-111,159-195) -------------------------
+
+    def start_async(self, w0: np.ndarray, assignment: np.ndarray, batch_size: int,
+                    learning_rate: float) -> None:
+        with self._w_lock:
+            self._w = jax.device_put(jnp.asarray(w0, dtype=jnp.float32), self.device)
+        self._assignment = jax.device_put(
+            jnp.asarray(assignment, dtype=jnp.int32), self.device
+        )
+        self._async_bs = int(batch_size)
+        self._async_lr = float(learning_rate)
+        self._running_async.set()
+        self._async_thread = threading.Thread(
+            target=self._async_loop, daemon=True, name=f"async-{self.port}"
+        )
+        self._async_thread.start()
+        self.log.info("async started: %d samples, bs=%d lr=%g",
+                      len(assignment), batch_size, learning_rate)
+
+    def stop_async(self) -> None:
+        self._running_async.clear()
+
+    def apply_delta(self, delta: np.ndarray) -> None:
+        """Peer/master UpdateGrad: w <- w - delta (Slave.scala:177-185)."""
+        with self._w_lock:
+            if self._w is not None:
+                self._w = self._apply(self._w, jnp.asarray(delta))
+        self.metrics.counter("slave.async.grad.update").increment()
+
+    def _async_loop(self) -> None:
+        bs, lr = self._async_bs, self._async_lr
+        n_assigned = int(self._assignment.shape[0])
+        model = self.model
+
+        def step(w, assignment, idx, val, y, key):
+            ids = assignment[jax.random.randint(key, (bs,), 0, n_assigned)]
+            batch = SparseBatch(idx[ids], val[ids])
+            g = model.grad_mean(w, batch, y[ids])  # MEAN (Slave.scala:93-98)
+            return lr * model.regularize(g, w)
+
+        step = jax.jit(step)
+        key = jax.random.PRNGKey(self.seed + self.port)
+        while self._running_async.is_set():
+            key, k = jax.random.split(key)
+            snapshot = self._w  # stale read is the algorithm
+            delta = step(snapshot, self._assignment, self._idx, self._val, self._y, k)
+            with self._w_lock:
+                self._w = self._apply(self._w, delta)
+            self.metrics.counter("slave.async.batch").increment()
+            msg = codec.encode_grad(np.asarray(delta))
+            with self._peers_lock:
+                peers = list(self._peers.values())
+            for peer in peers:  # fire-and-forget (Slave.scala:103-105)
+                peer.UpdateGrad.future(msg)
+            self._master.UpdateGrad.future(msg)
+
+
+class _WorkerServicer:
+    """gRPC method bodies (SlaveImpl, Slave.scala:113-196)."""
+
+    def __init__(self, w: WorkerNode):
+        self.w = w
+
+    def RegisterSlave(self, request, context):  # noqa: N802
+        self.w.add_peer(request.host, request.port)
+        return pb.Ack()
+
+    def UnregisterSlave(self, request, context):  # noqa: N802
+        self.w.remove_peer(request.host, request.port)
+        return pb.Ack()
+
+    def Forward(self, request, context):  # noqa: N802
+        w = codec.decode_tensor(request.weights)
+        ids = np.fromiter(request.samples, dtype=np.int64)
+        preds = self.w.compute_forward(w, ids)
+        return pb.ForwardReply(predictions=preds)
+
+    def Gradient(self, request, context):  # noqa: N802
+        w = codec.decode_tensor(request.weights)
+        ids = np.fromiter(request.samples, dtype=np.int64)
+        g = self.w.compute_gradient(w, ids)
+        return codec.encode_grad(g)
+
+    def StartAsync(self, request, context):  # noqa: N802
+        self.w.start_async(
+            codec.decode_tensor(request.weights),
+            np.fromiter(request.samples, dtype=np.int64),
+            request.batch_size,
+            request.learning_rate,
+        )
+        return pb.Ack()
+
+    def StopAsync(self, request, context):  # noqa: N802
+        self.w.stop_async()
+        return pb.Ack()
+
+    def UpdateGrad(self, request, context):  # noqa: N802
+        self.w.apply_delta(codec.decode_grad(request))
+        return pb.Ack()

@@ -1,0 +1,9 @@
+from distributed_sgd_tpu.rpc import codec  # noqa: F401
+from distributed_sgd_tpu.rpc.service import (  # noqa: F401
+    MasterStub,
+    WorkerStub,
+    add_master_servicer,
+    add_worker_servicer,
+    new_channel,
+    new_server,
+)

@@ -1,0 +1,51 @@
+"""numpy <-> wire codecs.
+
+The counterpart of the reference's ScalaPB TypeMappers that marshal proto
+maps into `math.Vec` (core/package.scala:11-13, proto.proto:8-11).  Dense
+f32 vectors travel as raw little-endian bytes; small-support deltas can
+travel as coordinate lists, chosen automatically by `encode_grad` when the
+sparse form is smaller on the wire.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+
+def encode_tensor(x: np.ndarray) -> pb.Tensor:
+    x = np.ascontiguousarray(np.asarray(x, dtype="<f4"))
+    return pb.Tensor(data=x.tobytes(), size=x.shape[0])
+
+
+def decode_tensor(t: pb.Tensor) -> np.ndarray:
+    return np.frombuffer(t.data, dtype="<f4", count=t.size).copy()
+
+
+def encode_grad(x: np.ndarray, sparse_threshold: float = 0.25) -> pb.GradUpdate:
+    """Dense or sparse wire form, whichever is smaller.
+
+    Coordinate form costs ~8 bytes/nonzero vs 4 bytes/element dense, so
+    sparse wins below ~50% density; the threshold is conservative.
+    """
+    x = np.asarray(x, dtype=np.float32)
+    nz = np.nonzero(x)[0]
+    if len(nz) <= sparse_threshold * len(x):
+        return pb.GradUpdate(
+            sparse=pb.SparseTensor(
+                indices=nz.astype(np.int32), values=x[nz], size=len(x)
+            )
+        )
+    return pb.GradUpdate(dense=encode_tensor(x))
+
+
+def decode_grad(g: pb.GradUpdate) -> np.ndarray:
+    if g.WhichOneof("grad") == "sparse":
+        out = np.zeros(g.sparse.size, dtype=np.float32)
+        if len(g.sparse.indices):
+            out[np.fromiter(g.sparse.indices, dtype=np.int64)] = np.fromiter(
+                g.sparse.values, dtype=np.float32
+            )
+        return out
+    return decode_tensor(g.dense)

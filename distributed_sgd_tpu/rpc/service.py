@@ -1,0 +1,98 @@
+"""Hand-written gRPC service bindings.
+
+grpc_tools (the protoc python-grpc plugin) is not available in this image,
+so stubs and servicer registration are built from a method table using
+grpc's generic API — functionally identical to generated `*_pb2_grpc.py`.
+Service surface mirrors the reference IDL (proto.proto:13-49); channel and
+server factories mirror core/package.scala:16-21 (plaintext).
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+_MASTER_METHODS = {
+    "RegisterSlave": (pb.Node, pb.Ack),
+    "UnregisterSlave": (pb.Node, pb.Ack),
+    "UpdateGrad": (pb.GradUpdate, pb.Ack),
+}
+
+_WORKER_METHODS = {
+    "RegisterSlave": (pb.Node, pb.Ack),
+    "UnregisterSlave": (pb.Node, pb.Ack),
+    "Forward": (pb.ForwardRequest, pb.ForwardReply),
+    "Gradient": (pb.GradientRequest, pb.GradUpdate),
+    "StartAsync": (pb.StartAsyncRequest, pb.Ack),
+    "StopAsync": (pb.Empty, pb.Ack),
+    "UpdateGrad": (pb.GradUpdate, pb.Ack),
+}
+
+
+def _add_servicer(server, servicer, service_name: str, methods: dict) -> None:
+    handlers = {}
+    for name, (req, resp) in methods.items():
+        fn = getattr(servicer, name)
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            fn, request_deserializer=req.FromString, response_serializer=resp.SerializeToString
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(service_name, handlers),)
+    )
+
+
+def add_master_servicer(server, servicer) -> None:
+    _add_servicer(server, servicer, "dsgd.Master", _MASTER_METHODS)
+
+
+def add_worker_servicer(server, servicer) -> None:
+    _add_servicer(server, servicer, "dsgd.Worker", _WORKER_METHODS)
+
+
+class _Stub:
+    def __init__(self, channel, service_name: str, methods: dict):
+        for name, (req, resp) in methods.items():
+            setattr(
+                self,
+                name,
+                channel.unary_unary(
+                    f"/{service_name}/{name}",
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                ),
+            )
+
+
+class MasterStub(_Stub):
+    def __init__(self, channel):
+        super().__init__(channel, "dsgd.Master", _MASTER_METHODS)
+
+
+class WorkerStub(_Stub):
+    def __init__(self, channel):
+        super().__init__(channel, "dsgd.Worker", _WORKER_METHODS)
+
+
+def new_server(port: int, host: str = "0.0.0.0", max_workers: int = 16) -> grpc.Server:
+    """Plaintext server factory (core/package.scala:16-17). Port 0 picks a
+    free port; the bound port is stored on `server.bound_port`."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                 ("grpc.max_send_message_length", 64 * 1024 * 1024)],
+    )
+    server.bound_port = server.add_insecure_port(f"{host}:{port}")
+    return server
+
+
+def new_channel(host: str, port: int) -> grpc.Channel:
+    """Plaintext channel factory (core/package.scala:19-21)."""
+    return grpc.insecure_channel(
+        f"{host}:{port}",
+        options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
+                 ("grpc.max_send_message_length", 64 * 1024 * 1024)],
+    )

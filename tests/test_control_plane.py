@@ -1,0 +1,127 @@
+"""Control-plane integration tests: real loopback gRPC, like the
+reference's dev mode (Main.scala:143-158).
+
+Covers codecs, membership (registration, readiness barrier, full-mesh
+introduction, capacity cap, unregister broadcast), sync fit over RPC,
+async Hogwild fit over RPC with best-weights return, and distributed
+eval fan-out."""
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.core.early_stopping import target
+from distributed_sgd_tpu.data.rcv1 import train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import LogisticRegression, SparseSVM
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+
+
+def test_codec_tensor_roundtrip():
+    x = np.random.default_rng(0).normal(size=100).astype(np.float32)
+    assert np.array_equal(codec.decode_tensor(codec.encode_tensor(x)), x)
+
+
+def test_codec_grad_sparse_and_dense():
+    dense = np.random.default_rng(1).normal(size=64).astype(np.float32)
+    assert codec.encode_grad(dense).WhichOneof("grad") == "dense"
+    np.testing.assert_array_equal(codec.decode_grad(codec.encode_grad(dense)), dense)
+    sparse = np.zeros(1000, dtype=np.float32)
+    sparse[[3, 500]] = [1.5, -2.0]
+    msg = codec.encode_grad(sparse)
+    assert msg.WhichOneof("grad") == "sparse"
+    np.testing.assert_array_equal(codec.decode_grad(msg), sparse)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=30))
+
+
+def _model():
+    return LogisticRegression(lam=1e-5, n_features=128, regularizer="l2")
+
+
+def test_cluster_forms_and_is_ready(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=3) as c:
+        assert c.master.cluster_ready.is_set()
+        assert len(c.master._workers) == 3
+        # full-mesh introduction: every worker knows the other two
+        for w in c.workers:
+            assert len(w._peers) == 2
+
+
+def test_register_beyond_capacity_rejected(data):
+    import grpc
+
+    from distributed_sgd_tpu.rpc.service import MasterStub, new_channel
+
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        stub = MasterStub(new_channel("127.0.0.1", c.master.port))
+        with pytest.raises(grpc.RpcError) as e:
+            stub.RegisterSlave(pb.Node(host="127.0.0.1", port=59999), timeout=5.0)
+        assert e.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+
+def test_unregister_broadcast(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=3) as c:
+        gone = c.workers[0]
+        gone.stop()
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+            (gone.host, gone.port) in w._peers for w in c.workers[1:]
+        ):
+            time.sleep(0.05)
+        for w in c.workers[1:]:
+            assert (gone.host, gone.port) not in w._peers
+        c.workers = c.workers[1:]  # don't double-stop
+
+
+def test_sync_fit_over_rpc_converges(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        res = c.master.fit_sync(max_epochs=5, batch_size=16, learning_rate=0.5)
+        assert res.epochs_run == 5
+        assert res.losses[-1] < res.losses[0]
+
+
+def test_async_fit_over_rpc_returns_best(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        res = c.master.fit_async(
+            max_epochs=20, batch_size=8, learning_rate=0.05,
+            check_every=50, leaky_loss=0.9, backoff_s=0.02,
+        )
+        assert len(res.test_losses) >= 1
+        assert res.state.loss == pytest.approx(min(res.test_losses), rel=1e-6)
+        assert res.state.updates > 0
+
+
+def test_async_early_stop_via_target(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        res = c.master.fit_async(
+            max_epochs=10_000, batch_size=8, learning_rate=0.05,
+            check_every=20, backoff_s=0.02, criterion=target(1e9),
+        )
+        assert len(res.test_losses) == 1  # stopped at first check
+
+
+def test_distributed_eval_fanout(data):
+    train, test = data
+    model = SparseSVM(lam=0.1, n_features=128, regularizer="l2")
+    with DevCluster(model, train, test, n_workers=2) as c:
+        w = np.random.default_rng(4).normal(size=128).astype(np.float32)
+        preds = c.master.predict(w)
+        assert preds.shape == (len(train),)
+        # distributed results must match master-local compiled eval
+        dloss = c.master.distributed_loss(w)
+        dacc = c.master.distributed_accuracy(w)
+        lloss, lacc = c.master.local_loss(w)
+        assert dloss == pytest.approx(lloss, rel=1e-4)
+        assert dacc == pytest.approx(lacc, rel=1e-6)
