@@ -1,0 +1,146 @@
+"""Benchmark harness: RCV1-scale sync epoch wall-clock on TPU.
+
+North-star metric (BASELINE.md): RCV1 epoch wall-clock at reference
+hyperparameters (batch 100, lr 0.5, lambda 1e-5, hinge SVM, 47,236
+features, 804,414 samples — application.conf defaults).  The real corpus
+is not downloadable in this environment, so the run uses synthetic data
+with RCV1's exact shape statistics (n, d, ~76 nnz/row, unit-norm rows).
+
+vs_baseline: the reference publishes no numbers (SURVEY.md §6), so the
+baseline is measured here: the reference's per-sample boxed sparse-map
+gradient loop (Slave.scala:147-152 semantics) implemented the way the
+reference implements it (hash-map arithmetic per sample), timed on this
+host over a sample and extrapolated to one epoch, then divided by
+JVM_SPEEDUP=10 as a conservative stand-in for Scala-vs-Python interpreter
+speed.  vs_baseline = conservative_jvm_epoch_seconds / tpu_epoch_seconds
+(higher is better; >10 meets the BASELINE.md target).
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+N_SAMPLES = 804_414  # DatasetTests.scala:18
+N_FEATURES = 47_236  # Dataset.scala:16
+NNZ = 76
+BATCH = 100  # application.conf:15
+LR = 0.5
+LAM = 1e-5
+JVM_SPEEDUP = 10.0  # conservative python->JVM factor for the baseline proxy
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def gen_data(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, N_FEATURES, size=(n, NNZ), dtype=np.int64).astype(np.int32)
+    idx.sort(axis=1)
+    val = np.abs(rng.normal(size=(n, NNZ))).astype(np.float32)
+    val /= np.maximum(np.linalg.norm(val, axis=1, keepdims=True), 1e-12)
+    w_true = rng.normal(size=N_FEATURES).astype(np.float32)
+    margins = np.einsum("np,np->n", val, w_true[idx])
+    y = np.where(margins > np.median(margins), 1, -1).astype(np.int32)
+    return idx, val, y
+
+
+def tpu_epoch_seconds(idx, val, y) -> tuple:
+    """One sync epoch (8,045 compiled steps) + full-train eval on TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sgd_tpu.data.rcv1 import Dataset
+    from distributed_sgd_tpu.models.linear import SparseSVM
+    from distributed_sgd_tpu.parallel.mesh import make_mesh
+    from distributed_sgd_tpu.parallel.sync import SyncEngine
+
+    n = len(y)
+    counts = np.bincount(idx.ravel(), minlength=N_FEATURES)
+    ds = np.zeros(N_FEATURES, dtype=np.float32)
+    nz = counts > 0
+    ds[nz] = 1.0 / (counts[nz] + 1.0)
+
+    model = SparseSVM(lam=LAM, n_features=N_FEATURES, dim_sparsity=jnp.asarray(ds))
+    mesh = make_mesh(1)  # one real chip; the same code scales the mesh
+    engine = SyncEngine(model, mesh, batch_size=BATCH, learning_rate=LR)
+    bound = engine.bind(Dataset(indices=idx, values=val, labels=y, n_features=N_FEATURES))
+    log(f"steps per epoch: {bound.steps_per_epoch}")
+
+    w = jnp.zeros((N_FEATURES,), dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+
+    t0 = time.perf_counter()
+    w = bound.epoch(w, key)
+    jax.block_until_ready(w)
+    compile_and_first = time.perf_counter() - t0
+    log(f"first epoch (incl. compile): {compile_and_first:.3f}s")
+
+    times = []
+    for i in range(3):
+        key, ek = jax.random.split(key)
+        t0 = time.perf_counter()
+        w = bound.epoch(w, ek)
+        jax.block_until_ready(w)
+        times.append(time.perf_counter() - t0)
+    epoch_s = float(np.median(times))
+    loss, acc = bound.evaluate(w)
+    log(f"epoch times: {['%.3f' % t for t in times]}; loss={loss:.4f} acc={acc:.4f}")
+    return epoch_s, loss, acc
+
+
+def baseline_epoch_seconds(idx, val, y, sample: int = 400) -> float:
+    """Reference-style per-sample boxed sparse-map gradient loop, timed on
+    `sample` samples and extrapolated to one epoch of n samples."""
+    n = len(y)
+    rows = [dict(zip(idx[i].tolist(), val[i].tolist())) for i in range(sample)]
+    w: dict = {}
+    t0 = time.perf_counter()
+    for i in range(sample):
+        x = rows[i]
+        margin = 0.0
+        for k, v in x.items():  # sparse dot (Sparse.scala:15-46)
+            margin += v * w.get(k, 0.0)
+        activity = y[i] * margin
+        if activity >= 0:  # backward = y*x (SparseSVM.scala:26-29)
+            yi = float(y[i])
+            for k, v in x.items():
+                w[k] = w.get(k, 0.0) - LR * yi * v
+    per_sample = (time.perf_counter() - t0) / sample
+    est = per_sample * n
+    log(f"baseline proxy: {per_sample*1e6:.1f}us/sample -> {est:.1f}s/epoch (python), "
+        f"{est/JVM_SPEEDUP:.1f}s (JVM conservative)")
+    return est / JVM_SPEEDUP
+
+
+def main() -> None:
+    log("generating RCV1-scale synthetic data...")
+    t0 = time.perf_counter()
+    idx, val, y = gen_data(N_SAMPLES)
+    log(f"generated in {time.perf_counter()-t0:.1f}s")
+
+    baseline_s = baseline_epoch_seconds(idx, val, y)
+    epoch_s, loss, acc = tpu_epoch_seconds(idx, val, y)
+
+    print(json.dumps({
+        "metric": "rcv1_sync_epoch_seconds",
+        "value": round(epoch_s, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline_s / epoch_s, 2),
+        "final_loss": round(float(loss), 4),
+        "final_acc": round(float(acc), 4),
+        "baseline_epoch_seconds_jvm_proxy": round(baseline_s, 2),
+        "n_samples": N_SAMPLES,
+        "n_features": N_FEATURES,
+        "batch_size": BATCH,
+    }))
+
+
+if __name__ == "__main__":
+    main()

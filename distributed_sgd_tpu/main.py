@@ -1,0 +1,199 @@
+"""Application entry point: config-driven role selection and scenario.
+
+Mirror of the reference Main (Main.scala:18-159): no CLI flags — behavior
+is driven entirely by DSGD_* env config.  Role selection
+(Main.scala:122-159):
+
+- master_host/master_port unset        -> dev mode (in-process cluster)
+- (master_host, master_port) == self   -> master process
+- otherwise                            -> worker process
+
+Dev mode picks the execution engine via DSGD_ENGINE:
+
+- ``mesh`` (default): the TPU-native fast path — in-mesh collectives
+  (parallel/sync.py or parallel/local_sgd.py / parallel/hogwild.py for
+  async) with no RPC data plane;
+- ``rpc``: reference-parity topology — an in-process gRPC cluster
+  (core/cluster.py), master fanning batches out to worker processes'
+  servicers exactly like the reference dev mode (Main.scala:143-158).
+
+The scenario (Main.scala:70-120): initial eval at w0 = 0, fit (sync or
+async per config), final weights + local test loss/acc logged.
+
+Run: ``python -m distributed_sgd_tpu.main``
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import sys
+
+import jax
+import numpy as np
+
+from distributed_sgd_tpu.config import Config
+from distributed_sgd_tpu.core.early_stopping import no_improvement
+from distributed_sgd_tpu.data.rcv1 import Dataset, dim_sparsity, load_rcv1, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.utils import measure
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+from distributed_sgd_tpu.utils.log import setup as setup_logging
+
+log = logging.getLogger("dsgd.main")
+
+
+def load_data(cfg: Config) -> Dataset:
+    """RCV1 from cfg.data_path, or synthetic via DSGD_SYNTHETIC=<n> when the
+    corpus is absent (no-egress environments)."""
+    synthetic = os.environ.get("DSGD_SYNTHETIC")
+    train_file = os.path.join(cfg.data_path, "lyrl2004_vectors_train.dat")
+    if synthetic or not os.path.exists(train_file):
+        n = int(synthetic or 100_000)
+        log.info("RCV1 not found or DSGD_SYNTHETIC set: generating %d synthetic rows", n)
+        return rcv1_like(n, seed=cfg.seed)
+    return load_rcv1(cfg.data_path, full=cfg.full, pad_width=cfg.pad_width)
+
+
+def build(cfg: Config):
+    data = measure.duration_log("data loaded", lambda: load_data(cfg), log)
+    train, test = train_test_split(data)
+    ds = measure.duration_log("dim sparsity", lambda: dim_sparsity(train), log)
+    model = make_model(cfg.model, cfg.lam, train.n_features, dim_sparsity=ds)
+    return train, test, model
+
+
+def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
+    """Dev-mode fast path: in-mesh engines, no RPC data plane."""
+    from distributed_sgd_tpu.parallel.mesh import make_mesh
+
+    n = min(cfg.node_count, len(jax.devices()))
+    mesh = make_mesh(n)
+    criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
+    log.info("engine=mesh devices=%d model=%s async=%s", n, cfg.model, cfg.use_async)
+
+    if cfg.use_async and cfg.async_mode == "gossip":
+        from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
+
+        eng = HogwildEngine(
+            model, n_workers=cfg.node_count, batch_size=cfg.batch_size,
+            learning_rate=cfg.learning_rate, check_every=cfg.check_every,
+            leaky_loss=cfg.leaky_loss, seed=cfg.seed,
+        )
+        res = eng.fit(train, test, cfg.max_epochs, criterion)
+    elif cfg.use_async:
+        from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
+
+        eng = LocalSGDEngine(
+            model, mesh, batch_size=cfg.batch_size,
+            learning_rate=cfg.learning_rate, sync_period=cfg.sync_period,
+            check_every=cfg.check_every, leaky_loss=cfg.leaky_loss, seed=cfg.seed,
+        )
+        res = eng.fit(train, test, cfg.max_epochs, criterion)
+    else:
+        from distributed_sgd_tpu.core.trainer import SyncTrainer
+
+        trainer = SyncTrainer(
+            model, mesh, batch_size=cfg.batch_size,
+            learning_rate=cfg.learning_rate, seed=cfg.seed,
+        )
+        res = trainer.fit(train, test, cfg.max_epochs, criterion)
+
+    _finish(cfg, res)
+
+
+def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
+    """Dev-mode reference-parity path: in-process gRPC cluster."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+
+    criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
+    with DevCluster(model, train, test, n_workers=cfg.node_count, seed=cfg.seed) as c:
+        w0 = np.zeros(model.n_features, dtype=np.float32)
+        loss0, acc0 = c.master.local_loss(w0, test=False)
+        log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
+        if cfg.use_async:
+            res = c.master.fit_async(
+                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
+                check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
+            )
+        else:
+            res = c.master.fit_sync(
+                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion
+            )
+        _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True))
+
+
+def _finish(cfg: Config, res, evaluator=None) -> None:
+    w = res.state.weights
+    log.info("fit done: %d epochs, final loss=%.6f, %d updates",
+             res.epochs_run, res.state.loss, res.state.updates)
+    if evaluator is None:
+        log.info("test losses: %s", ", ".join(f"{x:.6f}" for x in res.test_losses))
+    else:
+        tl, ta = evaluator(np.asarray(w))
+        log.info("final test loss=%.6f acc=%.4f", tl, ta)
+    if cfg.checkpoint_dir:
+        from distributed_sgd_tpu.checkpoint import Checkpointer
+
+        Checkpointer(cfg.checkpoint_dir).save(res.epochs_run, w)
+
+
+def main() -> None:
+    setup_logging()
+    cfg = Config.from_env()
+    log.info("host: %s (%s)", socket.gethostname(), sys.platform)
+    log.info("config: %s", cfg.to_json())
+    np.random.seed(cfg.seed)  # Main.scala:32 Random.setSeed(0)
+
+    exporter = None
+    if cfg.record and cfg.metrics_port is not None:
+        from distributed_sgd_tpu.utils.metrics import PrometheusExporter
+
+        exporter = PrometheusExporter(metrics_mod.global_metrics(), cfg.metrics_port).start()
+        log.info("metrics exporter on :%d", exporter.port)
+
+    role = cfg.role
+    if role == "dev":
+        train, test, model = build(cfg)
+        engine = os.environ.get("DSGD_ENGINE", "mesh")
+        if engine == "rpc":
+            scenario_rpc(cfg, train, test, model)
+        else:
+            scenario_mesh(cfg, train, test, model)
+    elif role == "master":
+        from distributed_sgd_tpu.core.master import MasterNode
+
+        train, test, model = build(cfg)
+        master = MasterNode(
+            cfg.host, cfg.port, train, test, model,
+            expected_workers=cfg.node_count, seed=cfg.seed,
+        ).start()
+        criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
+        master.await_ready()
+        if cfg.use_async:
+            res = master.fit_async(
+                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
+                check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
+            )
+        else:
+            res = master.fit_sync(cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion)
+        _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True))
+        master.stop()
+    else:  # worker
+        from distributed_sgd_tpu.core.worker import WorkerNode
+
+        train, _, model = build(cfg)
+        worker = WorkerNode(
+            cfg.host, cfg.port, cfg.master_host, cfg.master_port, train, model,
+            seed=cfg.seed,
+        ).start()
+        worker.await_termination()
+
+    if exporter is not None:
+        exporter.stop()
+
+
+if __name__ == "__main__":
+    main()
