@@ -1,0 +1,138 @@
+"""Kernel microbenchmarks — the reference SparseBench equivalent.
+
+The reference benches its two sparse representations (`Sparse` map vs
+`SparseArrayVector` CSR) on addition / elementwise product / dot /
+scalar multiplication / normSquared over 100 real RCV1 rows
+(src/test/scala/epfl/distributed/math/SparseBench.scala:22-68).  This
+benches the same five ops over RCV1-shaped rows in three implementations:
+
+- `xla`: this framework's padded-sparse batch kernels (jit'd, on the
+  default JAX platform — TPU when available);
+- `scipy`: scipy.sparse CSR on CPU (a strong conventional baseline);
+- `boxed`: per-row python dict arithmetic, the reference's cost model
+  (boxed per-entry ops, fresh map per operation).
+
+Usage: python benches/sparse_bench.py [n_rows]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_rows(n_rows: int, n_features: int = 47236, nnz: int = 76, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, n_features, size=(n_rows, nnz), dtype=np.int64).astype(np.int32)
+    idx.sort(axis=1)
+    val = rng.random((n_rows, nnz)).astype(np.float32)
+    return idx, val
+
+
+def timeit(fn, reps: int = 5) -> float:
+    fn()  # warmup / compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def bench_xla(idx, val, w):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sgd_tpu.ops.sparse import SparseBatch, matvec, scatter_add
+
+    d = len(w)
+    batch = SparseBatch(jnp.asarray(idx), jnp.asarray(val))
+    wj = jnp.asarray(w)
+    coeff = jnp.ones(idx.shape[0], dtype=jnp.float32)
+
+    dot = jax.jit(lambda b, w: matvec(b, w))
+    add = jax.jit(lambda b, c: scatter_add(b, c, d))  # keyset-union sum of rows
+    scal = jax.jit(lambda b: SparseBatch(b.indices, b.values * 2.0))
+    prod = jax.jit(lambda b, w: b.values * jnp.take(w, b.indices))  # x * w elementwise
+    norm2 = jax.jit(lambda b: jnp.sum(b.values**2, axis=-1))
+
+    block = jax.block_until_ready
+    return {
+        "dot": timeit(lambda: block(dot(batch, wj))),
+        "add(sum rows)": timeit(lambda: block(add(batch, coeff))),
+        "scalar*": timeit(lambda: block(scal(batch))),
+        "elementwise*": timeit(lambda: block(prod(batch, wj))),
+        "normSquared": timeit(lambda: block(norm2(batch))),
+    }
+
+
+def bench_scipy(idx, val, w):
+    from scipy import sparse
+
+    n, p = idx.shape
+    d = len(w)
+    indptr = np.arange(0, n * p + 1, p)
+    m = sparse.csr_matrix((val.ravel(), idx.ravel(), indptr), shape=(n, d))
+    return {
+        "dot": timeit(lambda: m @ w),
+        "add(sum rows)": timeit(lambda: np.asarray(m.sum(axis=0))),
+        "scalar*": timeit(lambda: m * 2.0),
+        "elementwise*": timeit(lambda: m.multiply(w)),
+        "normSquared": timeit(lambda: np.asarray(m.multiply(m).sum(axis=1))),
+    }
+
+
+def bench_boxed(idx, val, w):
+    rows = [dict(zip(i.tolist(), v.tolist())) for i, v in zip(idx, val)]
+
+    def dot():
+        return [sum(v * w[k] for k, v in r.items()) for r in rows]
+
+    def add():
+        acc: dict = {}
+        for r in rows:  # keyset-union fold, fresh map per merge (Vec.scala:133-137)
+            acc = {k: acc.get(k, 0.0) + r.get(k, 0.0) for k in acc.keys() | r.keys()}
+        return acc
+
+    def scal():
+        return [{k: v * 2.0 for k, v in r.items()} for r in rows]
+
+    def prod():
+        return [{k: v * w[k] for k, v in r.items()} for r in rows]
+
+    def norm2():
+        return [sum(v * v for v in r.values()) for r in rows]
+
+    return {
+        "dot": timeit(dot, reps=3),
+        "add(sum rows)": timeit(add, reps=3),
+        "scalar*": timeit(scal, reps=3),
+        "elementwise*": timeit(prod, reps=3),
+        "normSquared": timeit(norm2, reps=3),
+    }
+
+
+def main() -> None:
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 100  # SparseBench.scala:22
+    idx, val = make_rows(n_rows)
+    w = np.random.default_rng(1).random(47236).astype(np.float32)
+
+    results = {
+        "xla": bench_xla(idx, val, w),
+        "scipy": bench_scipy(idx, val, w),
+        "boxed": bench_boxed(idx, val, w),
+    }
+    ops = list(results["xla"])
+    print(f"{n_rows} rows x 76 nnz, 47,236 features (median seconds)")
+    print(f"{'op':>14} " + " ".join(f"{k:>12}" for k in results))
+    for op in ops:
+        print(f"{op:>14} " + " ".join(f"{results[k][op]:12.6f}" for k in results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Build the node image (reference build.sh:1-8 equivalent).
+set -euo pipefail
+cd "$(dirname "$0")"
+docker build -f kube/Dockerfile -t dsgd-tpu:node .

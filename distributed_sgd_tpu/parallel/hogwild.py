@@ -46,6 +46,7 @@ import numpy as np
 
 from distributed_sgd_tpu.core.early_stopping import Criterion
 from distributed_sgd_tpu.core.grad_state import GradState
+from distributed_sgd_tpu.core.loss_check import LossChecker
 from distributed_sgd_tpu.core.split import vanilla_split
 from distributed_sgd_tpu.core.trainer import FitResult
 from distributed_sgd_tpu.data.rcv1 import Dataset
@@ -252,9 +253,7 @@ class HogwildEngine:
         eval_bound = SyncEngine(self.model, make_mesh(1), self.batch_size, 0.0).bind(test)
 
         result = FitResult(state=GradState(weights=self._w_master))
-        best_loss = float("inf")
-        best_w = w0
-        smoothed_hist: List[float] = []  # newest first
+        checker = LossChecker(self.leaky_loss, criterion)
         t_start = time.time()
 
         for w in workers:
@@ -270,24 +269,14 @@ class HogwildEngine:
                     self._stop.wait(self.backoff_s)
                     continue
                 raw_loss, raw_acc = eval_bound.evaluate(w_now)
-                prev = smoothed_hist[0] if smoothed_hist else raw_loss
-                loss = self.leaky_loss * raw_loss + (1 - self.leaky_loss) * prev
-                prev_acc = result.test_accuracies[-1] if result.test_accuracies else raw_acc
-                acc = self.leaky_loss * raw_acc + (1 - self.leaky_loss) * prev_acc
-                smoothed_hist.insert(0, loss)
-                result.test_losses.append(loss)
-                result.test_accuracies.append(acc)
-                self.metrics.counter("master.async.loss").increment(int(loss))
+                stop = checker.check(raw_loss, raw_acc, w_now)
+                self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
                 log.info(
                     "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
-                    updates, loss, acc,
+                    updates, checker.smoothed[0], checker.smoothed_accs[0],
                 )
-                if loss < best_loss:  # best-so-far (MasterAsync.scala:130-139)
-                    best_loss = loss
-                    best_w = np.asarray(w_now)
-                    log.info("best loss so far!")
                 last_step = updates
-                if criterion is not None and criterion(smoothed_hist):
+                if stop:
                     log.info("converged to target: stopping computation")
                     self._stop.set()
         finally:
@@ -297,9 +286,12 @@ class HogwildEngine:
                 w.join()
 
         # return BEST weights (MasterAsync.scala:87-94)
+        result.test_losses = checker.history
+        result.test_accuracies = checker.acc_history
+        best_w = checker.best_weights if checker.best_weights is not None else w0
         result.state = GradState(
             weights=jnp.asarray(best_w),
-            loss=best_loss if best_loss != float("inf") else float("nan"),
+            loss=checker.best_loss if checker.best_loss != float("inf") else float("nan"),
             start=t_start,
             updates=self._updates,
         ).finish()

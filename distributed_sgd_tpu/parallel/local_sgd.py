@@ -21,15 +21,16 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import List, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from distributed_sgd_tpu.core.early_stopping import Criterion
 from distributed_sgd_tpu.core.grad_state import GradState
+from distributed_sgd_tpu.core.loss_check import LossChecker
 from distributed_sgd_tpu.core.trainer import FitResult
 from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
@@ -114,8 +115,7 @@ class LocalSGDEngine:
         )
         key = jax.random.PRNGKey(self.seed)
         result = FitResult(state=GradState(weights=w))
-        smoothed: List[float] = []  # newest first
-        best_loss, best_w = float("inf"), np.asarray(w)
+        checker = LossChecker(self.leaky_loss, criterion)
         steps_done, last_check = 0, -self.check_every
         t_start = time.time()
 
@@ -131,27 +131,22 @@ class LocalSGDEngine:
             if steps_done - last_check < self.check_every:
                 continue
             raw_loss, raw_acc = eval_bound.evaluate(w)
-            prev = smoothed[0] if smoothed else raw_loss
-            loss = self.leaky_loss * raw_loss + (1 - self.leaky_loss) * prev
-            prev_acc = result.test_accuracies[-1] if result.test_accuracies else raw_acc
-            acc = self.leaky_loss * raw_acc + (1 - self.leaky_loss) * prev_acc
-            smoothed.insert(0, loss)
-            result.test_losses.append(loss)
-            result.test_accuracies.append(acc)
+            stop = checker.check(raw_loss, raw_acc, w)
             log.info(
                 "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
-                steps_done, loss, acc,
+                steps_done, checker.smoothed[0], checker.smoothed_accs[0],
             )
-            if loss < best_loss:
-                best_loss, best_w = loss, np.asarray(w)
             last_check = steps_done
-            if criterion is not None and criterion(smoothed):
+            if stop:
                 log.info("converged to target: stopping computation")
                 break
 
+        result.test_losses = checker.history
+        result.test_accuracies = checker.acc_history
+        best_w = checker.best_weights if checker.best_weights is not None else np.asarray(w)
         result.state = GradState(
             weights=jnp.asarray(best_w),
-            loss=best_loss if best_loss != float("inf") else float("nan"),
+            loss=checker.best_loss if checker.best_loss != float("inf") else float("nan"),
             start=t_start,
             updates=steps_done,
         ).finish()

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Launch the cluster (reference run.sh:1-54 equivalent):
+#   ./run.sh -sync | -async
+# Applies the matching ConfigMap + topology, tails the master log, and
+# tears the cluster down on Ctrl-C.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+case "${1:--sync}" in
+  -sync) CONFIG=kube/config-sync.yaml ;;
+  -async) CONFIG=kube/config-async.yaml ;;
+  *) echo "usage: $0 [-sync|-async]" >&2; exit 1 ;;
+esac
+
+cleanup() {
+  kubectl delete -f kube/dsgd.yaml --ignore-not-found
+  kubectl delete -f "$CONFIG" --ignore-not-found
+}
+trap cleanup INT TERM
+
+kubectl create -f "$CONFIG"
+kubectl create -f kube/dsgd.yaml
+
+echo "waiting for master pod..."
+kubectl wait --for=condition=ready pod -l app=dsgd-master --timeout=300s
+kubectl logs -f deployment/dsgd-master
