@@ -95,15 +95,45 @@ class MasterNode:
         self.port = self.port or self.server.bound_port
         add_master_servicer(self.server, _MasterServicer(self))
 
+        # heartbeat failure detection (superset; SURVEY.md §5.3: the
+        # reference has none and a dead worker hangs the sync barrier)
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self) -> "MasterNode":
+    def start(self, heartbeat_s: Optional[float] = None) -> "MasterNode":
         self.server.start()
         self.log.info("master started on %s:%d, expecting %d workers",
                       self.host, self.port, self.expected_workers)
+        if heartbeat_s:
+            self._hb_thread = threading.Thread(
+                target=self._heartbeat_loop, args=(heartbeat_s,),
+                daemon=True, name="heartbeat",
+            )
+            self._hb_thread.start()
         return self
 
+    def _heartbeat_loop(self, interval_s: float, max_failures: int = 3) -> None:
+        failures: Dict[Tuple[str, int], int] = {}
+        while not self._hb_stop.wait(interval_s):
+            with self._members_lock:
+                members = list(self._workers.items())
+            for key, stub in members:
+                try:
+                    stub.Ping(pb.Empty(), timeout=interval_s)
+                    failures.pop(key, None)
+                except grpc.RpcError:
+                    failures[key] = failures.get(key, 0) + 1
+                    self.log.warning("heartbeat miss %d/%d for %s:%d",
+                                     failures[key], max_failures, *key)
+                    if failures[key] >= max_failures:
+                        self.log.warning("worker %s:%d declared dead", *key)
+                        failures.pop(key, None)
+                        self.unregister_worker(*key)
+
     def stop(self) -> None:
+        self._hb_stop.set()
         self._async_running.clear()
         self.server.stop(grace=1.0)
         for ch in self._channels.values():

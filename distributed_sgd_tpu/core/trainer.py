@@ -56,11 +56,17 @@ class SyncTrainer:
         sampling: str = "fresh",
         metrics: Optional[metrics_mod.Metrics] = None,
         seed: int = 0,
+        profile_dir: Optional[str] = None,
+        checkpointer=None,
+        checkpoint_every: int = 1,
     ):
         self.engine = SyncEngine(model, mesh, batch_size, learning_rate, sampling=sampling)
         self.model = model
         self.metrics = metrics or metrics_mod.global_metrics()
         self.seed = seed
+        self.profile_dir = profile_dir  # jax.profiler trace of epoch 1
+        self.checkpointer = checkpointer  # checkpoint.Checkpointer or None
+        self.checkpoint_every = checkpoint_every
 
     def fit(
         self,
@@ -81,13 +87,27 @@ class SyncTrainer:
         result = FitResult(state=GradState(weights=w))
         test_losses_newest_first: List[float] = []
 
-        for epoch in range(max_epochs):
+        start_epoch = 0
+        if self.checkpointer is not None:
+            restored = self.checkpointer.restore_latest()
+            if restored is not None:
+                start_epoch, state = restored
+                w = jnp.asarray(state["weights"])
+                log.info("resumed from checkpoint at epoch %d", start_epoch)
+
+        for epoch in range(start_epoch, max_epochs):
+            profiling = self.profile_dir is not None and epoch == start_epoch + 1
+            if profiling:  # second epoch: steady-state, compile excluded
+                jax.profiler.start_trace(self.profile_dir)
             t0 = time.perf_counter()
             key, ek = jax.random.split(key)
             with self.metrics.timer("master.sync.batch.duration"):
                 w = bound_train.epoch(w, ek)
                 jax.block_until_ready(w)
             epoch_s = time.perf_counter() - t0
+            if profiling:
+                jax.profiler.stop_trace()
+                log.info("profiler trace written to %s", self.profile_dir)
 
             loss, acc = bound_train.evaluate(w)
             test_loss, test_acc = bound_test.evaluate(w)
@@ -106,6 +126,9 @@ class SyncTrainer:
                 "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
                 epoch, loss, acc, test_loss, test_acc, epoch_s,
             )
+
+            if self.checkpointer is not None and (epoch + 1) % self.checkpoint_every == 0:
+                self.checkpointer.save(epoch + 1, w)
 
             if criterion is not None and criterion(test_losses_newest_first):
                 log.info("Converged to target: stopping computation")

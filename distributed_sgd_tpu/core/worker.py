@@ -274,6 +274,9 @@ class _WorkerServicer:
         self.w.remove_peer(request.host, request.port)
         return pb.Ack()
 
+    def Ping(self, request, context):  # noqa: N802
+        return pb.Ack()
+
     def Forward(self, request, context):  # noqa: N802
         w = codec.decode_tensor(request.weights)
         ids = np.fromiter(request.samples, dtype=np.int64)

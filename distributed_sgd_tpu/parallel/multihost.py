@@ -1,0 +1,68 @@
+"""Multi-host initialization: the same `workers` axis over DCN.
+
+The reference scales across hosts by running one JVM per pod with gRPC
+between them (kube/dsgd.yaml).  Here multi-host data parallelism uses
+`jax.distributed` + a GLOBAL mesh: every host calls `initialize()` with
+the same coordinator, `global_mesh()` spans all hosts' devices on the one
+`workers` axis, and the engines in parallel/sync.py / parallel/local_sgd.py
+run unchanged — XLA routes the psum/pmean over ICI within a slice and DCN
+across slices (SURVEY.md §5.8).
+
+Host-local data loading: each host loads/keeps only its devices' shards.
+`host_shard_bounds()` gives this host's contiguous sample range under the
+same vanilla contiguous assignment the single-host path uses, so a
+multi-host loader can read just its slice of the corpus.
+
+The gRPC control plane (core/master.py / core/worker.py) remains available
+for clusters WITHOUT a shared jax mesh (e.g. CPU worker fleets), and for
+the async gossip mode across hosts.
+
+Untestable on this single-chip environment; exercised structurally in
+tests (bounds math) and by dryrun_multichip on the virtual mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+import jax
+
+from distributed_sgd_tpu.parallel.mesh import make_mesh
+
+log = logging.getLogger("dsgd.multihost")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """jax.distributed.initialize with env fallback (JAX_COORDINATOR_ADDRESS
+    etc. are honored when args are None)."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "distributed initialized: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def global_mesh():
+    """1-D workers mesh over ALL hosts' devices (jax.devices() is global)."""
+    return make_mesh(len(jax.devices()))
+
+
+def host_shard_bounds(n_samples: int, process_id: Optional[int] = None,
+                      num_processes: Optional[int] = None) -> Tuple[int, int]:
+    """This host's contiguous [start, end) sample range under the global
+    vanilla split (device order == process order for a 1-D mesh)."""
+    pid = jax.process_index() if process_id is None else process_id
+    n_proc = jax.process_count() if num_processes is None else num_processes
+    per = -(-n_samples // n_proc)  # ceil
+    start = min(pid * per, n_samples)
+    return start, min(start + per, n_samples)

@@ -25,6 +25,7 @@ _MASTER_METHODS = {
 _WORKER_METHODS = {
     "RegisterSlave": (pb.Node, pb.Ack),
     "UnregisterSlave": (pb.Node, pb.Ack),
+    "Ping": (pb.Empty, pb.Ack),
     "Forward": (pb.ForwardRequest, pb.ForwardReply),
     "Gradient": (pb.GradientRequest, pb.GradUpdate),
     "StartAsync": (pb.StartAsyncRequest, pb.Ack),

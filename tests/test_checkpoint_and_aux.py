@@ -1,0 +1,103 @@
+"""Checkpoint/resume, heartbeat failure detection, multihost bounds,
+and measure-span tests."""
+
+import logging
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.checkpoint import Checkpointer
+from distributed_sgd_tpu.core.trainer import SyncTrainer
+from distributed_sgd_tpu.data.rcv1 import train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import LogisticRegression
+from distributed_sgd_tpu.parallel.mesh import make_mesh
+from distributed_sgd_tpu.parallel.multihost import host_shard_bounds
+from distributed_sgd_tpu.utils.measure import duration, span
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    w = np.arange(10, dtype=np.float32)
+    ckpt.save(3, w, extra={"loss": np.float32(0.5)})
+    step, state = ckpt.restore_latest()
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(state["weights"]), w)
+    assert float(state["loss"]) == 0.5
+    ckpt.close()
+
+
+def test_checkpoint_keeps_latest(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ckpt"), keep=2)
+    for step in (1, 2, 3):
+        ckpt.save(step, np.full(4, float(step), dtype=np.float32))
+    step, state = ckpt.restore_latest()
+    assert step == 3
+    np.testing.assert_array_equal(np.asarray(state["weights"]), np.full(4, 3.0))
+    ckpt.close()
+
+
+def test_trainer_resumes_from_checkpoint(tmp_path):
+    train, test = train_test_split(rcv1_like(160, n_features=64, nnz=6, seed=40))
+    model = LogisticRegression(lam=0.0, n_features=64, regularizer="none")
+    ckpt = Checkpointer(str(tmp_path / "ckpt"))
+    t1 = SyncTrainer(model, make_mesh(2), 16, 0.5, checkpointer=ckpt)
+    r1 = t1.fit(train, test, max_epochs=3)
+    ckpt.close()
+
+    ckpt2 = Checkpointer(str(tmp_path / "ckpt"))
+    t2 = SyncTrainer(model, make_mesh(2), 16, 0.5, checkpointer=ckpt2)
+    r2 = t2.fit(train, test, max_epochs=5)  # resumes at epoch 3
+    ckpt2.close()
+    assert r2.epochs_run == 5
+    assert len(r2.losses) == 2  # only epochs 3 and 4 ran after resume
+
+
+def test_heartbeat_detects_dead_worker():
+    from distributed_sgd_tpu.core.cluster import DevCluster
+
+    train, test = train_test_split(rcv1_like(80, n_features=32, nnz=4, seed=41))
+    model = LogisticRegression(lam=0.0, n_features=32, regularizer="none")
+    c = DevCluster(model, train, test, n_workers=2)
+    try:
+        # restart master-side monitoring with a fast cadence
+        c.master._hb_thread = None
+        import threading
+
+        c.master._hb_thread = threading.Thread(
+            target=c.master._heartbeat_loop, args=(0.1, 2), daemon=True
+        )
+        c.master._hb_thread.start()
+        dead = c.workers[0]
+        dead.server.stop(grace=0)  # crash, no unregister
+        deadline = time.time() + 10
+        while time.time() < deadline and (dead.host, dead.port) in c.master._workers:
+            time.sleep(0.05)
+        assert (dead.host, dead.port) not in c.master._workers
+    finally:
+        c.master._hb_stop.set()
+        c.workers[0]._stopped.set()
+        c.workers[0]._registered.clear()  # skip unregister RPC on stop
+        c.workers = c.workers[1:]
+        c.stop()
+
+
+def test_host_shard_bounds_cover_and_partition():
+    n, k = 103, 4
+    spans = [host_shard_bounds(n, pid, k) for pid in range(k)]
+    covered = []
+    for s, e in spans:
+        covered.extend(range(s, e))
+    assert covered == list(range(n))
+
+
+def test_measure_span_records_histogram():
+    from distributed_sgd_tpu.utils.metrics import Metrics
+
+    m = Metrics()
+    with span("unit", logger=logging.getLogger("t"), metrics=m):
+        pass
+    assert m.histogram("span.unit").count == 1
+    out, secs = duration(lambda: 42)
+    assert out == 42 and secs >= 0
