@@ -57,6 +57,7 @@ class Config:
     async_mode: str = "gossip"  # gossip | local_sgd
     sync_period: int = 16  # local-SGD averaging period (steps)
     checkpoint_dir: Optional[str] = None
+    heartbeat_s: Optional[float] = None  # master worker-failure detection period
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     profile_dir: Optional[str] = None  # jax.profiler trace output
     pad_width: Optional[int] = None  # sparse-batch nnz padding (None = auto)
@@ -96,6 +97,7 @@ class Config:
             async_mode=_env("DSGD_ASYNC_MODE", cls.async_mode, str),
             sync_period=_env("DSGD_SYNC_PERIOD", cls.sync_period, int),
             checkpoint_dir=_env("DSGD_CHECKPOINT_DIR", None, str),
+            heartbeat_s=_env("DSGD_HEARTBEAT_S", None, float),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),
             pad_width=_env("DSGD_PAD_WIDTH", None, int),

@@ -34,12 +34,13 @@ class DevCluster:
         base_port: int = 0,
         devices=None,
         seed: int = 0,
+        heartbeat_s: Optional[float] = None,
     ):
         devs = list(devices if devices is not None else jax.devices())
         self.master = MasterNode(
             host, base_port, train, test, model,
             expected_workers=n_workers, seed=seed,
-        ).start()
+        ).start(heartbeat_s=heartbeat_s)
         self.workers: List[WorkerNode] = []
         for i in range(n_workers):
             port = 0 if base_port == 0 else base_port + 1 + i

@@ -119,11 +119,24 @@ class MasterNode:
         while not self._hb_stop.wait(interval_s):
             with self._members_lock:
                 members = list(self._workers.items())
+            # probe concurrently so one dead worker costs one timeout, not D
+            futs = []
             for key, stub in members:
                 try:
-                    stub.Ping(pb.Empty(), timeout=interval_s)
-                    failures.pop(key, None)
-                except grpc.RpcError:
+                    futs.append((key, stub.Ping.future(pb.Empty(), timeout=interval_s)))
+                except ValueError:  # channel closed under us (unregister/stop)
+                    futs.append((key, None))
+            for key, fut in futs:
+                try:
+                    if fut is not None:
+                        fut.result()
+                        failures.pop(key, None)
+                        continue
+                except (grpc.RpcError, ValueError):
+                    pass
+                with self._members_lock:
+                    still_member = key in self._workers
+                if still_member:
                     failures[key] = failures.get(key, 0) + 1
                     self.log.warning("heartbeat miss %d/%d for %s:%d",
                                      failures[key], max_failures, *key)

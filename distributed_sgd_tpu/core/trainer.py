@@ -83,7 +83,7 @@ class SyncTrainer:
             if initial_weights is None
             else jnp.asarray(initial_weights, dtype=jnp.float32)
         )
-        key = jax.random.PRNGKey(self.seed)
+        base_key = jax.random.PRNGKey(self.seed)
         result = FitResult(state=GradState(weights=w))
         test_losses_newest_first: List[float] = []
 
@@ -95,12 +95,19 @@ class SyncTrainer:
                 w = jnp.asarray(state["weights"])
                 log.info("resumed from checkpoint at epoch %d", start_epoch)
 
+        # prefer the second epoch (steady-state, compile excluded) but fall
+        # back to the only epoch when the fit runs just one
+        profile_epoch = start_epoch + 1 if max_epochs > start_epoch + 1 else start_epoch
+        profiled = False
         for epoch in range(start_epoch, max_epochs):
-            profiling = self.profile_dir is not None and epoch == start_epoch + 1
-            if profiling:  # second epoch: steady-state, compile excluded
+            profiling = self.profile_dir is not None and epoch == profile_epoch
+            if profiling:
                 jax.profiler.start_trace(self.profile_dir)
+                profiled = True
             t0 = time.perf_counter()
-            key, ek = jax.random.split(key)
+            # keyed by absolute epoch index: a resumed run continues the same
+            # batch-sampling stream instead of replaying epochs 0..N-1's keys
+            ek = jax.random.fold_in(base_key, epoch)
             with self.metrics.timer("master.sync.batch.duration"):
                 w = bound_train.epoch(w, ek)
                 jax.block_until_ready(w)
@@ -136,6 +143,11 @@ class SyncTrainer:
         else:
             if max_epochs > 0:
                 log.info("Reached max number of epochs: stopping computation")
+        if self.profile_dir is not None and not profiled:
+            log.warning(
+                "no profiler trace captured: the fit stopped before epoch %d",
+                profile_epoch,
+            )
 
         result.state = GradState(
             weights=w, loss=result.losses[-1] if result.losses else float("nan")

@@ -169,7 +169,7 @@ def main() -> None:
         master = MasterNode(
             cfg.host, cfg.port, train, test, model,
             expected_workers=cfg.node_count, seed=cfg.seed,
-        ).start()
+        ).start(heartbeat_s=cfg.heartbeat_s)
         criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
         master.await_ready()
         if cfg.use_async:

@@ -9,9 +9,10 @@ run unchanged — XLA routes the psum/pmean over ICI within a slice and DCN
 across slices (SURVEY.md §5.8).
 
 Host-local data loading: each host loads/keeps only its devices' shards.
-`host_shard_bounds()` gives this host's contiguous sample range under the
-same vanilla contiguous assignment the single-host path uses, so a
-multi-host loader can read just its slice of the corpus.
+`host_shard_bounds()` gives this host's contiguous row range in the
+engine's PADDED row space (parallel/sync.py `padded_layout`), so a
+multi-host loader can read just its slice of the corpus; rows with index
+>= n_samples are padding and must be materialised as zero rows (label 0).
 
 The gRPC control plane (core/master.py / core/worker.py) remains available
 for clusters WITHOUT a shared jax mesh (e.g. CPU worker fleets), and for
@@ -57,12 +58,31 @@ def global_mesh():
     return make_mesh(len(jax.devices()))
 
 
-def host_shard_bounds(n_samples: int, process_id: Optional[int] = None,
-                      num_processes: Optional[int] = None) -> Tuple[int, int]:
-    """This host's contiguous [start, end) sample range under the global
-    vanilla split (device order == process order for a 1-D mesh)."""
+def host_shard_bounds(
+    n_samples: int,
+    process_id: Optional[int] = None,
+    num_processes: Optional[int] = None,
+    local_device_count: Optional[int] = None,
+    eval_chunk: int = 4096,
+) -> Tuple[int, int]:
+    """This host's contiguous [start, end) row range in the engine's PADDED
+    row space.
+
+    Matches SyncEngine.bind exactly: the dataset is padded to
+    `padded_layout(n, n_devices, eval_chunk)` rows and sharded equally over
+    the global 1-D device mesh, so device d owns padded rows
+    [d*per_dev, (d+1)*per_dev).  Assumes jax's default device order (each
+    process's addressable devices contiguous, process-major).  Rows with
+    index >= n_samples are padding: the loader materialises them as
+    all-zero rows with label 0.
+    """
+    from distributed_sgd_tpu.parallel.sync import padded_layout
+
     pid = jax.process_index() if process_id is None else process_id
     n_proc = jax.process_count() if num_processes is None else num_processes
-    per = -(-n_samples // n_proc)  # ceil
-    start = min(pid * per, n_samples)
-    return start, min(start + per, n_samples)
+    local = jax.local_device_count() if local_device_count is None else local_device_count
+    n_dev = n_proc * local
+    total, _ = padded_layout(n_samples, n_dev, eval_chunk)
+    per_dev = total // n_dev
+    start = pid * local * per_dev
+    return start, start + local * per_dev

@@ -246,12 +246,8 @@ class SyncEngine:
         n_true = len(data)
         if n_true < n_workers:
             raise ValueError(f"dataset of {n_true} rows < {n_workers} workers")
-        # pad so each equal shard is a multiple of the eval chunk -> the
-        # chunked eval scan never reads out of range and pads are masked
-        shard = math.ceil(n_true / n_workers)
-        chunk = min(self.eval_chunk, shard)
-        shard_padded = math.ceil(shard / chunk) * chunk
-        padded = _pad_to_exact(data, n_workers * shard_padded)
+        total, chunk = padded_layout(n_true, n_workers, self.eval_chunk)
+        padded = _pad_to_exact(data, total)
         sharding = NamedSharding(self.mesh, P(AXIS))
         sharded = ShardedData(
             indices=jax.device_put(padded.indices, sharding),
@@ -269,6 +265,18 @@ class SyncEngine:
             steps_per_epoch=steps_per_epoch,
             eval_chunk=chunk,
         )
+
+
+def padded_layout(n_true: int, n_workers: int, eval_chunk: int = 4096) -> Tuple[int, int]:
+    """(padded_total, chunk) for the engine's resident-dataset layout: each
+    of the n_workers equal shards is padded to a multiple of the eval chunk
+    so the chunked eval scan never reads out of range (pads carry label 0
+    and are masked).  Multi-host loaders use this to reproduce per-device
+    row ownership without materialising the global array (multihost.py)."""
+    shard = math.ceil(n_true / n_workers)
+    chunk = min(eval_chunk, shard)
+    shard_padded = math.ceil(shard / chunk) * chunk
+    return n_workers * shard_padded, chunk
 
 
 def _pad_to_exact(data: Dataset, target: int) -> Dataset:

@@ -53,6 +53,14 @@ def test_trainer_resumes_from_checkpoint(tmp_path):
     assert r2.epochs_run == 5
     assert len(r2.losses) == 2  # only epochs 3 and 4 ran after resume
 
+    # resumed run continues the per-epoch RNG stream: identical weights to
+    # the same fit run uninterrupted
+    t3 = SyncTrainer(model, make_mesh(2), 16, 0.5)
+    r3 = t3.fit(train, test, max_epochs=5)
+    np.testing.assert_allclose(
+        np.asarray(r2.state.weights), np.asarray(r3.state.weights), rtol=1e-6
+    )
+
 
 def test_heartbeat_detects_dead_worker():
     from distributed_sgd_tpu.core.cluster import DevCluster
@@ -84,12 +92,44 @@ def test_heartbeat_detects_dead_worker():
 
 
 def test_host_shard_bounds_cover_and_partition():
-    n, k = 103, 4
-    spans = [host_shard_bounds(n, pid, k) for pid in range(k)]
+    # 4 hosts x 2 devices: spans partition the PADDED row space and align
+    # with what the engine's per-device sharding would give each host
+    from distributed_sgd_tpu.parallel.sync import padded_layout
+
+    n, n_proc, local = 103, 4, 2
+    total, _ = padded_layout(n, n_proc * local, eval_chunk=4096)
+    spans = [host_shard_bounds(n, pid, n_proc, local) for pid in range(n_proc)]
     covered = []
     for s, e in spans:
         covered.extend(range(s, e))
-    assert covered == list(range(n))
+    assert covered == list(range(total))
+    assert total >= n
+
+
+def test_host_shard_bounds_match_engine_sharding():
+    # the helper's [start, end) must equal the rows this "host"'s devices
+    # actually own under SyncEngine.bind's NamedSharding on the 8-dev mesh
+    import jax
+
+    from distributed_sgd_tpu.parallel.sync import SyncEngine
+
+    n, n_features = 50, 32
+    data = rcv1_like(n, n_features=n_features, nnz=4, seed=7)
+    model = LogisticRegression(lam=0.0, n_features=n_features, regularizer="none")
+    mesh = make_mesh(8)
+    bound = SyncEngine(model, mesh, batch_size=4, learning_rate=0.1,
+                       eval_chunk=4).bind(data)
+    labels = bound.data.labels
+    # treat the 8 devices as 4 hosts x 2 devices
+    dev_rows = {}
+    for shard in labels.addressable_shards:
+        (rs,) = shard.index
+        dev_rows[shard.device.id] = (rs.start, rs.stop)
+    order = [d.id for d in mesh.devices.flat]
+    for pid in range(4):
+        s, e = host_shard_bounds(n, pid, 4, 2, eval_chunk=4)
+        d0, d1 = order[2 * pid], order[2 * pid + 1]
+        assert (s, e) == (dev_rows[d0][0], dev_rows[d1][1])
 
 
 def test_measure_span_records_histogram():
