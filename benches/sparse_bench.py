@@ -8,6 +8,8 @@ benches the same five ops over RCV1-shaped rows in three implementations:
 
 - `xla`: this framework's padded-sparse batch kernels (jit'd, on the
   default JAX platform — TPU when available);
+- `xla_flat`: the flat CSR-style layout (ops/flat_sparse.py), the
+  SparseArrayVector counterpart in the rep-vs-rep comparison;
 - `scipy`: scipy.sparse CSR on CPU (a strong conventional baseline);
 - `boxed`: per-row python dict arithmetic, the reference's cost model
   (boxed per-entry ops, fresh map per operation).
@@ -71,6 +73,36 @@ def bench_xla(idx, val, w):
     }
 
 
+def bench_xla_flat(idx, val, w):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_sgd_tpu.ops import flat_sparse
+    from distributed_sgd_tpu.ops.sparse import SparseBatch
+
+    d = len(w)
+    flat = flat_sparse.from_padded(SparseBatch(jnp.asarray(idx), jnp.asarray(val)))
+    wj = jnp.asarray(w)
+    coeff = jnp.ones(idx.shape[0], dtype=jnp.float32)
+
+    dot = jax.jit(lambda b, w: flat_sparse.matvec(b, w))
+    add = jax.jit(lambda b, c: flat_sparse.scatter_add(b, c, d))
+    scal = jax.jit(lambda b: b._replace(values=b.values * 2.0))
+    prod = jax.jit(lambda b, w: b.values * jnp.take(w, b.indices))
+    norm2 = jax.jit(
+        lambda b: jax.ops.segment_sum(b.values**2, b.rows, num_segments=b.n_rows)
+    )
+
+    block = jax.block_until_ready
+    return {
+        "dot": timeit(lambda: block(dot(flat, wj))),
+        "add(sum rows)": timeit(lambda: block(add(flat, coeff))),
+        "scalar*": timeit(lambda: block(scal(flat))),
+        "elementwise*": timeit(lambda: block(prod(flat, wj))),
+        "normSquared": timeit(lambda: block(norm2(flat))),
+    }
+
+
 def bench_scipy(idx, val, w):
     from scipy import sparse
 
@@ -124,6 +156,7 @@ def main() -> None:
 
     results = {
         "xla": bench_xla(idx, val, w),
+        "xla_flat": bench_xla_flat(idx, val, w),
         "scipy": bench_scipy(idx, val, w),
         "boxed": bench_boxed(idx, val, w),
     }

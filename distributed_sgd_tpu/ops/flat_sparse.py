@@ -1,0 +1,122 @@
+"""Flat (CSR-style) sparse batch — the SparseArrayVector analogue.
+
+The reference carries a second, experimental sparse representation next to
+its map-backed one: `SparseArrayVector`, a CSR-ish ``(indices, values)``
+array pair built for the ScalaMeter bench and not used in the training path
+(math/SparseArrayVector.scala:10-47; SURVEY.md §2.1).  This module is the
+TPU-native counterpart: a *flat* layout with one entry per stored nonzero,
+
+    FlatSparseBatch(indices: int32[T], values: f32[T], rows: int32[T], n_rows)
+
+where ``rows[t]`` says which sample entry t belongs to.  Versus the padded
+``SparseBatch`` (ops/sparse.py) it wastes no lanes on padding when row nnz
+varies wildly — the same trade the reference benches map-vs-CSR for
+(SparseBench.scala:34-68); benches/sparse_bench.py compares them here.
+
+Kernels mirror ops/sparse.py exactly:
+- ``matvec``: per-row dots as gather + multiply + ``segment_sum`` over rows;
+- ``scatter_add``: sum_i coeff_i * x_i as one flat scatter-add.
+
+T (total stored entries) must be static for XLA, so batches are padded to a
+fixed T with (index 0, value 0, row 0) entries — inert in both kernels.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sgd_tpu.ops.sparse import SparseBatch
+
+
+class FlatSparseBatch(NamedTuple):
+    """One entry per stored nonzero, row-tagged; padded entries carry 0s.
+
+    indices: int32[T] — 0-based feature ids (0 for padding)
+    values:  f32[T]   — feature values (0.0 for padding)
+    rows:    int32[T] — owning sample per entry (0 for padding)
+    n_rows:  int      — static batch size B
+    """
+
+    indices: jax.Array
+    values: jax.Array
+    rows: jax.Array
+    n_rows: int
+
+
+def matvec(batch: FlatSparseBatch, w: jax.Array) -> jax.Array:
+    """out[b] = sum over entries of row b of values * w[indices].
+
+    The flat-layout twin of ops.sparse.matvec (Vec.scala:58 semantics);
+    the row reduction is a segment sum, which XLA lowers to a TPU-friendly
+    sorted-segment scatter.
+    """
+    prod = batch.values.astype(jnp.float32) * jnp.take(w, batch.indices).astype(jnp.float32)
+    return jax.ops.segment_sum(prod, batch.rows, num_segments=batch.n_rows)
+
+
+def scatter_add(batch: FlatSparseBatch, coeff: jax.Array, n_features: int) -> jax.Array:
+    """out = sum_b coeff[b] * x_b — ops.sparse.scatter_add for the flat layout."""
+    weighted = batch.values.astype(jnp.float32) * jnp.take(coeff.astype(jnp.float32), batch.rows)
+    return jnp.zeros((n_features,), dtype=jnp.float32).at[batch.indices].add(weighted)
+
+
+def from_padded(batch: SparseBatch, total: Optional[int] = None) -> FlatSparseBatch:
+    """Flatten a padded [B, P] batch, dropping pad lanes (host-side).
+
+    total: static T to pad the flat arrays to (default: count of stored
+    nonzeros, which makes the result shape data-dependent — fine outside
+    jit, e.g. when packing host-resident data once).
+    """
+    idx = np.asarray(batch.indices)
+    val = np.asarray(batch.values)
+    b, p = idx.shape
+    keep = val != 0
+    rows = np.broadcast_to(np.arange(b, dtype=np.int32)[:, None], (b, p))[keep]
+    flat_idx, flat_val = idx[keep].astype(np.int32), val[keep].astype(np.float32)
+    t = int(total) if total is not None else len(flat_idx)
+    if len(flat_idx) > t:
+        raise ValueError(f"{len(flat_idx)} stored entries exceed total={t}")
+    out_i = np.zeros(t, dtype=np.int32)
+    out_v = np.zeros(t, dtype=np.float32)
+    out_r = np.zeros(t, dtype=np.int32)
+    out_i[: len(flat_idx)] = flat_idx
+    out_v[: len(flat_val)] = flat_val
+    out_r[: len(rows)] = rows
+    return FlatSparseBatch(
+        indices=jnp.asarray(out_i),
+        values=jnp.asarray(out_v),
+        rows=jnp.asarray(out_r),
+        n_rows=b,
+    )
+
+
+def from_csr(
+    row_ptr: np.ndarray,
+    col_idx: np.ndarray,
+    values: np.ndarray,
+    total: Optional[int] = None,
+) -> FlatSparseBatch:
+    """Host CSR (the loader's native output, data/rcv1.py) -> flat batch,
+    the same construction as SparseArrayVector.csrFormat
+    (SparseArrayVector.scala:116-131) without the text round-trip."""
+    nnz = np.diff(row_ptr).astype(np.int64)
+    rows = np.repeat(np.arange(len(nnz), dtype=np.int32), nnz)
+    t = int(total) if total is not None else len(col_idx)
+    if len(col_idx) > t:
+        raise ValueError(f"{len(col_idx)} stored entries exceed total={t}")
+    out_i = np.zeros(t, dtype=np.int32)
+    out_v = np.zeros(t, dtype=np.float32)
+    out_r = np.zeros(t, dtype=np.int32)
+    out_i[: len(col_idx)] = col_idx.astype(np.int32)
+    out_v[: len(values)] = values.astype(np.float32)
+    out_r[: len(rows)] = rows
+    return FlatSparseBatch(
+        indices=jnp.asarray(out_i),
+        values=jnp.asarray(out_v),
+        rows=jnp.asarray(out_r),
+        n_rows=len(nnz),
+    )

@@ -1,0 +1,114 @@
+"""Tests for ops/gradcheck.py (F.scala parity) and ops/flat_sparse.py
+(SparseArrayVector parity): numeric-vs-analytic gradients and
+padded-vs-flat kernel equivalence."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.models.linear import LeastSquares, LogisticRegression
+from distributed_sgd_tpu.ops import flat_sparse
+from distributed_sgd_tpu.ops.gradcheck import check_grad, numeric_grad
+from distributed_sgd_tpu.ops.sparse import SparseBatch, matvec, scatter_add
+
+
+def _rand_batch(b=6, p=5, d=40, seed=0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, d, (b, p)).astype(np.int32)
+    val = rng.normal(size=(b, p)).astype(np.float32)
+    val[rng.random((b, p)) < 0.2] = 0.0  # some explicit pads
+    y = rng.choice([-1, 1], b).astype(np.int32)
+    return SparseBatch(jnp.asarray(idx), jnp.asarray(val)), jnp.asarray(y), d
+
+
+class TestNumericGrad:
+    def test_quadratic(self):
+        # f(x) = sum(x^2) -> grad 2x (F.scala:10-18 central difference)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=8), dtype=jnp.float32)
+        g = numeric_grad(lambda v: jnp.sum(v**2), x, eps=1e-2)
+        assert np.allclose(np.asarray(g), 2 * np.asarray(x), atol=1e-2)
+
+    def test_coords_subset(self):
+        x = jnp.arange(5, dtype=jnp.float32)
+        g = numeric_grad(lambda v: jnp.sum(v**2), x, eps=1e-2, coords=jnp.asarray([1, 3]))
+        assert g.shape == (2,)
+        assert np.allclose(np.asarray(g), [2.0, 6.0], atol=1e-2)
+
+    @pytest.mark.parametrize("cls", [LogisticRegression, LeastSquares])
+    def test_model_grads_match_numeric(self, cls):
+        # smooth models: analytic grad_mean == d objective/dw (without reg
+        # term, so use lam=0); validates grad_coeff + scatter_add together
+        batch, y, d = _rand_batch(seed=3)
+        model = cls(lam=0.0, n_features=d, regularizer="none")
+        w = jnp.asarray(np.random.default_rng(1).normal(size=d) * 0.1, dtype=jnp.float32)
+        probe = jnp.asarray(np.unique(np.asarray(batch.indices))[:12])
+        assert check_grad(
+            lambda v: model.objective(v, batch, y),
+            lambda v: model.grad_mean(v, batch, y),
+            w,
+            eps=1e-2,
+            atol=5e-3,
+            rtol=5e-2,
+            coords=probe,
+        )
+
+
+class TestFlatSparse:
+    def test_matvec_matches_padded(self):
+        batch, _, d = _rand_batch(seed=5)
+        flat = flat_sparse.from_padded(batch)
+        w = jnp.asarray(np.random.default_rng(2).normal(size=d), dtype=jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(flat_sparse.matvec(flat, w)),
+            np.asarray(matvec(batch, w)),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_scatter_matches_padded(self):
+        batch, _, d = _rand_batch(seed=6)
+        flat = flat_sparse.from_padded(batch)
+        coeff = jnp.asarray(np.random.default_rng(3).normal(size=batch.batch_size), dtype=jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(flat_sparse.scatter_add(flat, coeff, d)),
+            np.asarray(scatter_add(batch, coeff, d)),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+
+    def test_padding_to_total_is_inert(self):
+        batch, _, d = _rand_batch(seed=7)
+        w = jnp.asarray(np.random.default_rng(4).normal(size=d), dtype=jnp.float32)
+        tight = flat_sparse.from_padded(batch)
+        padded = flat_sparse.from_padded(batch, total=int(tight.indices.shape[0]) + 17)
+        np.testing.assert_allclose(
+            np.asarray(flat_sparse.matvec(padded, w)),
+            np.asarray(flat_sparse.matvec(tight, w)),
+            rtol=1e-6,
+        )
+        coeff = jnp.ones(batch.batch_size, dtype=jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(flat_sparse.scatter_add(padded, coeff, d)),
+            np.asarray(flat_sparse.scatter_add(tight, coeff, d)),
+            rtol=1e-6,
+        )
+
+    def test_from_csr_roundtrip(self):
+        rng = np.random.default_rng(8)
+        row_ptr = np.array([0, 3, 3, 7], dtype=np.int64)  # middle row empty
+        col_idx = rng.integers(0, 30, 7).astype(np.int32)
+        values = rng.normal(size=7).astype(np.float32)
+        flat = flat_sparse.from_csr(row_ptr, col_idx, values)
+        assert flat.n_rows == 3
+        w = jnp.asarray(rng.normal(size=30), dtype=jnp.float32)
+        out = np.asarray(flat_sparse.matvec(flat, w))
+        expect = np.zeros(3, dtype=np.float32)
+        for r in range(3):
+            s, e = row_ptr[r], row_ptr[r + 1]
+            expect[r] = (values[s:e] * np.asarray(w)[col_idx[s:e]]).sum()
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+    def test_overflow_raises(self):
+        batch, _, _ = _rand_batch(seed=9)
+        with pytest.raises(ValueError):
+            flat_sparse.from_padded(batch, total=1)
