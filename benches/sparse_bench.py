@@ -81,7 +81,9 @@ def bench_xla_flat(idx, val, w):
     from distributed_sgd_tpu.ops.sparse import SparseBatch
 
     d = len(w)
-    flat = flat_sparse.from_padded(SparseBatch(jnp.asarray(idx), jnp.asarray(val)))
+    # pass HOST arrays: from_padded is host-side, and a device->host pull
+    # mid-process degrades every later dispatch on the axon TPU tunnel
+    flat = flat_sparse.from_padded(SparseBatch(idx, val))
     wj = jnp.asarray(w)
     coeff = jnp.ones(idx.shape[0], dtype=jnp.float32)
 

@@ -23,7 +23,8 @@ fixed T with (index 0, value 0, row 0) entries — inert in both kernels.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from dataclasses import dataclass, replace
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -32,19 +33,32 @@ import numpy as np
 from distributed_sgd_tpu.ops.sparse import SparseBatch
 
 
-class FlatSparseBatch(NamedTuple):
+@jax.tree_util.register_pytree_node_class
+@dataclass(frozen=True)
+class FlatSparseBatch:
     """One entry per stored nonzero, row-tagged; padded entries carry 0s.
 
     indices: int32[T] — 0-based feature ids (0 for padding)
     values:  f32[T]   — feature values (0.0 for padding)
     rows:    int32[T] — owning sample per entry (0 for padding)
-    n_rows:  int      — static batch size B
+    n_rows:  int      — static batch size B (pytree aux data, so kernels
+                        stay jittable with it as a compile-time constant)
     """
 
     indices: jax.Array
     values: jax.Array
     rows: jax.Array
     n_rows: int
+
+    def tree_flatten(self):
+        return (self.indices, self.values, self.rows), self.n_rows
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], aux)
+
+    def _replace(self, **kw) -> "FlatSparseBatch":
+        return replace(self, **kw)
 
 
 def matvec(batch: FlatSparseBatch, w: jax.Array) -> jax.Array:
@@ -66,6 +80,10 @@ def scatter_add(batch: FlatSparseBatch, coeff: jax.Array, n_features: int) -> ja
 
 def from_padded(batch: SparseBatch, total: Optional[int] = None) -> FlatSparseBatch:
     """Flatten a padded [B, P] batch, dropping pad lanes (host-side).
+
+    Prefer passing a batch of HOST (numpy) arrays: this function pulls data
+    to host, and a device->host transfer can be expensive (and on some
+    remote-TPU transports degrades later dispatch latency).
 
     total: static T to pad the flat arrays to (default: count of stored
     nonzeros, which makes the result shape data-dependent — fine outside
