@@ -38,7 +38,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
+from distributed_sgd_tpu.ops import mxu
 from distributed_sgd_tpu.ops.sparse import SparseBatch, matvec, scatter_add
 
 
@@ -117,6 +119,55 @@ class LinearModel:
         if self.regularizer == "l2":
             return grad + 2.0 * self.lam * w
         return grad
+
+    # -- blocked (MXU one-hot) fast path -----------------------------------
+    #
+    # Same math on the [R, 128] lane-blocked weight view (ops/mxu.py):
+    # the training engines keep weights blocked across their compiled scans
+    # and convert at the jit boundary.  Semantics match the scalar path
+    # bit-for-bit up to float summation order.
+
+    @property
+    def dim_sparsity_blocked(self) -> Optional[jax.Array]:
+        if self.dim_sparsity is None:
+            return None
+        if not hasattr(self, "_ds_blocked_np"):
+            # cache the HOST array; the jnp conversion must happen inside
+            # each trace (caching a traced array would leak the tracer)
+            self._ds_blocked_np = mxu.to_blocked_np(
+                np.asarray(self.dim_sparsity), self.n_features
+            )
+        return jnp.asarray(self._ds_blocked_np)
+
+    def margins_blocked(self, w2: jax.Array, batch: SparseBatch) -> jax.Array:
+        return mxu.matvec(batch, w2)
+
+    def grad_blocked(
+        self, w2: jax.Array, batch: SparseBatch, y: jax.Array, reduce: str = "sum"
+    ) -> jax.Array:
+        """Batched backward on blocked weights: one fused gather + coeff +
+        scatter with the one-hot operands built once (ops/mxu.py).
+
+        reduce='sum' is the sync worker reply (Slave.scala:147-153);
+        reduce='mean' is the async local step (Slave.scala:93-98).
+        """
+        oh = mxu.OneHotBatch(batch, w2.shape[0])
+        coeff = self.grad_coeff(oh.margins(w2), y)
+        if reduce == "mean":
+            coeff = coeff / batch.batch_size
+        return oh.scatter_add(coeff)
+
+    def regularize_blocked(self, g2: jax.Array, w2: jax.Array) -> jax.Array:
+        """`regularize` on the blocked view; zero pad lanes stay zero
+        because the scalar is only added where g2 != 0."""
+        if self.regularizer == "dim_sparsity":
+            scalar = self.lam * 2.0 * jnp.sum(
+                w2.astype(jnp.float32) * self.dim_sparsity_blocked
+            )
+            return g2 + jnp.where(g2 != 0, scalar, 0.0)
+        if self.regularizer == "l2":
+            return g2 + 2.0 * self.lam * w2
+        return g2
 
 
 class SparseSVM(LinearModel):

@@ -17,6 +17,13 @@ The whole epoch is ONE compiled program: no host round-trips, no
 serialization of the 47k-dim weight vector per batch per worker (the
 reference ships it over gRPC every batch, Master.scala:184-189).
 
+Two kernel backends (`kernel=`): 'mxu' (default) keeps weights in the
+lane-blocked [R, 128] view across the epoch scan and runs the sparse
+gather/scatter as one-hot MXU matmuls (ops/mxu.py, ~4x faster per step at
+RCV1 shapes); 'scalar' is the reference-shaped take/scatter path
+(ops/sparse.py).  Both produce identical updates up to float summation
+order (tests/test_mxu_kernels.py).
+
 Batch sampling mirrors Master.scala:184 (`split.map(Random.shuffle(_))`
 then slice): every step each worker draws a fresh uniform batch from its
 shard.  `sampling='fresh'` reproduces this with per-step uniform draws
@@ -41,6 +48,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
+from distributed_sgd_tpu.ops import mxu
 from distributed_sgd_tpu.ops.sparse import SparseBatch
 from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS
 
@@ -67,9 +75,13 @@ class BoundSync:
         sampling: str = "fresh",
         steps_per_epoch: Optional[int] = None,
         eval_chunk: int = 4096,
+        kernel: str = "mxu",
     ):
         if sampling not in ("fresh", "epoch"):
             raise ValueError(f"sampling must be 'fresh' or 'epoch', got {sampling!r}")
+        if kernel not in ("mxu", "scalar"):
+            raise ValueError(f"kernel must be 'mxu' or 'scalar', got {kernel!r}")
+        self.kernel = kernel
         self.model = model
         self.mesh = mesh
         self.data = data
@@ -138,26 +150,46 @@ class BoundSync:
         return jax.lax.dynamic_slice(perm, (start,), (self.batch_size,))
 
     def _one_step(self, w, idx, val, y, key, step):
+        """One sync DP step on weights in the kernel's native layout:
+        dense [D] for 'scalar', lane-blocked [R, 128] for 'mxu'."""
         ids = self._sample_ids(key, step)
         batch = SparseBatch(idx[ids], val[ids])
         by = y[ids]
-        g = self.model.grad_sum(w, batch, by)  # worker-side SUM (Slave.scala:153)
-        g = self.model.regularize(g, w)  # worker-side (Slave.scala:155)
+        if self.kernel == "mxu":
+            g = self.model.grad_blocked(w, batch, by)  # SUM (Slave.scala:153)
+            g = self.model.regularize_blocked(g, w)  # (Slave.scala:155)
+        else:
+            g = self.model.grad_sum(w, batch, by)  # worker-side SUM (Slave.scala:153)
+            g = self.model.regularize(g, w)  # worker-side (Slave.scala:155)
         g = jax.lax.psum(g, AXIS) / self.n_workers  # master mean (Master.scala:194)
         return w - self.learning_rate * g
 
+    def _to_kernel_layout(self, w):
+        if self.kernel == "mxu":
+            return mxu.to_blocked(w, self.model.n_features)
+        return w
+
+    def _from_kernel_layout(self, w):
+        if self.kernel == "mxu":
+            return mxu.from_blocked(w, self.model.n_features)
+        return w
+
     def _epoch_shard(self, w, idx, val, y, key):
         key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
+        w = self._to_kernel_layout(w)
 
         def body(w, step):
             return self._one_step(w, idx, val, y, key, step), ()
 
         w, _ = jax.lax.scan(body, w, jnp.arange(self.steps_per_epoch))
-        return w
+        return self._from_kernel_layout(w)
 
     def _step_shard(self, w, idx, val, y, key):
         key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
-        return self._one_step(w, idx, val, y, key, jnp.int32(0))
+        w = self._to_kernel_layout(w)
+        return self._from_kernel_layout(
+            self._one_step(w, idx, val, y, key, jnp.int32(0))
+        )
 
     def _eval_shard(self, w, idx, val, y) -> Tuple[jax.Array, jax.Array]:
         # chunked scan so the working set stays small; pads (label 0) masked;
@@ -233,6 +265,7 @@ class SyncEngine:
         learning_rate: float,
         sampling: str = "fresh",
         eval_chunk: int = 4096,
+        kernel: str = "mxu",
     ):
         self.model = model
         self.mesh = mesh
@@ -240,6 +273,7 @@ class SyncEngine:
         self.learning_rate = learning_rate
         self.sampling = sampling
         self.eval_chunk = eval_chunk
+        self.kernel = kernel
 
     def bind(self, data: Dataset, steps_per_epoch: Optional[int] = None) -> BoundSync:
         n_workers = self.mesh.shape[AXIS]
@@ -264,6 +298,7 @@ class SyncEngine:
             sampling=self.sampling,
             steps_per_epoch=steps_per_epoch,
             eval_chunk=chunk,
+            kernel=self.kernel,
         )
 
 
