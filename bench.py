@@ -1,19 +1,46 @@
 """Benchmark harness: RCV1-scale sync epoch wall-clock on TPU.
 
-North-star metric (BASELINE.md): RCV1 epoch wall-clock at reference
-hyperparameters (batch 100, lr 0.5, lambda 1e-5, hinge SVM, 47,236
-features, 804,414 samples — application.conf defaults).  The real corpus
-is not downloadable in this environment, so the run uses synthetic data
-with RCV1's exact shape statistics (n, d, ~76 nnz/row, unit-norm rows).
+North-star metric (BASELINE.md): RCV1 sync-SGD epoch wall-clock at the
+reference's application.conf defaults — batch 100, lr 0.5, lambda 1e-5,
+hinge SVM, nodeCount=3 workers (application.conf:15-28), 47,236 features,
+804,414 samples.  The real corpus is not downloadable in this environment,
+so the run uses synthetic data with RCV1's exact shape statistics (n, d,
+~76 nnz/row, unit-norm rows).
+
+The TPU side runs the same topology the reference runs: 3 workers, each
+computing a per-batch 100-sample gradient sum + regularize, mean-reduced
+every step (SyncEngine virtual_workers=3 on one chip; on a pod the same
+code spreads workers over the mesh).  Timing is slope-fit over
+multi-epoch single-dispatch runs so per-dispatch transport overhead (the
+remote-TPU tunnel adds ~100 ms per call) is excluded: epoch_s =
+(t[3 epochs] - t[1 epoch]) / 2, with device->host pulls forcing real
+synchronization around each timed region.
 
 vs_baseline: the reference publishes no numbers (SURVEY.md §6), so the
-baseline is measured here: the reference's per-sample boxed sparse-map
-gradient loop (Slave.scala:147-152 semantics) implemented the way the
-reference implements it (hash-map arithmetic per sample), timed on this
-host over a sample and extrapolated to one epoch, then divided by
-JVM_SPEEDUP=10 as a conservative stand-in for Scala-vs-Python interpreter
-speed.  vs_baseline = conservative_jvm_epoch_seconds / tpu_epoch_seconds
-(higher is better; >10 meets the BASELINE.md target).
+baseline is MODELED from the reference's own algorithm structure
+(Master.scala:179-198), conservatively in the JVM's favor:
+
+ 1. worker compute  — the per-sample boxed sparse-map backward loop
+    (Slave.scala:147-152 semantics) timed in python on this host, divided
+    by JVM_SPEEDUP=10 (a generous python->Scala factor given the reference
+    uses boxed spire.math.Number maps, typically no faster than python
+    floats in dicts), divided by nodeCount (workers run in parallel);
+ 2. master reduce   — Vec.mean over nodeCount sparse worker grads + the
+    weight update (Master.scala:194-197), timed in python as dict merges,
+    divided by JVM_SPEEDUP (serial, on the master);
+ 3. wire codecs     — every batch the master serializes the FULL sparse
+    weight vector once per worker and each worker deserializes it, and
+    each worker serializes its gradient reply which the master
+    deserializes (proto map<int32,double>, proto.proto:28-31;
+    Master.scala:184-189).  Bytes are counted exactly (13 B/entry, weight
+    density evolved by the coupon-collector union over sampled features)
+    and charged at WIRE_GBPS=1.0 GB/s end-to-end — far faster than
+    ScalaPB boxed-map codecs achieve in practice.  Network transit itself
+    is charged at zero.
+
+Items the real reference also pays that are deliberately EXCLUDED (each
+would only raise the baseline): per-epoch full-dataset master eval
+(Master.scala:201-209), gRPC framing/HTTP2, STM/executor overhead, GC.
 
 Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 """
@@ -21,6 +48,7 @@ Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 from __future__ import annotations
 
 import json
+import math
 import sys
 import time
 
@@ -30,9 +58,14 @@ N_SAMPLES = 804_414  # DatasetTests.scala:18
 N_FEATURES = 47_236  # Dataset.scala:16
 NNZ = 76
 BATCH = 100  # application.conf:15
+N_WORKERS = 3  # application.conf nodeCount (dev defaults)
 LR = 0.5
 LAM = 1e-5
 JVM_SPEEDUP = 10.0  # conservative python->JVM factor for the baseline proxy
+WIRE_GBPS = 1.0  # generous JVM proto map<int32,double> codec throughput
+BYTES_PER_ENTRY = 13  # proto map entry: tag+varint key + tag+fixed64 value
+
+STEPS_PER_EPOCH = math.ceil(math.ceil(N_SAMPLES / N_WORKERS) / BATCH)
 
 
 def log(msg: str) -> None:
@@ -52,7 +85,7 @@ def gen_data(n: int, seed: int = 0):
 
 
 def tpu_epoch_seconds(idx, val, y) -> tuple:
-    """One sync epoch (8,045 compiled steps) + full-train eval on TPU."""
+    """Slope-fit sync epoch wall-clock on the TPU (3-worker topology)."""
     import jax
     import jax.numpy as jnp
 
@@ -61,62 +94,114 @@ def tpu_epoch_seconds(idx, val, y) -> tuple:
     from distributed_sgd_tpu.parallel.mesh import make_mesh
     from distributed_sgd_tpu.parallel.sync import SyncEngine
 
-    n = len(y)
     counts = np.bincount(idx.ravel(), minlength=N_FEATURES)
     ds = np.zeros(N_FEATURES, dtype=np.float32)
     nz = counts > 0
     ds[nz] = 1.0 / (counts[nz] + 1.0)
 
     model = SparseSVM(lam=LAM, n_features=N_FEATURES, dim_sparsity=jnp.asarray(ds))
-    mesh = make_mesh(1)  # one real chip; the same code scales the mesh
-    engine = SyncEngine(model, mesh, batch_size=BATCH, learning_rate=LR)
+    mesh = make_mesh(1)  # one real chip; same code scales over the mesh
+    engine = SyncEngine(
+        model, mesh, batch_size=BATCH, learning_rate=LR, virtual_workers=N_WORKERS
+    )
     bound = engine.bind(Dataset(indices=idx, values=val, labels=y, n_features=N_FEATURES))
-    log(f"steps per epoch: {bound.steps_per_epoch}")
+    log(f"steps per epoch: {bound.steps_per_epoch} "
+        f"(= ceil(ceil({N_SAMPLES}/{N_WORKERS})/{BATCH}))")
 
-    w = jnp.zeros((N_FEATURES,), dtype=jnp.float32)
+    w0 = jnp.zeros((N_FEATURES,), dtype=jnp.float32)
     key = jax.random.PRNGKey(0)
+    _ = np.asarray(jnp.zeros(4))  # force synchronous dispatch on the tunnel
 
-    t0 = time.perf_counter()
-    w = bound.epoch(w, key)
-    jax.block_until_ready(w)
-    compile_and_first = time.perf_counter() - t0
-    log(f"first epoch (incl. compile): {compile_and_first:.3f}s")
-
-    times = []
-    for i in range(3):
-        key, ek = jax.random.split(key)
+    times = {}
+    for n_ep in (1, 3):
         t0 = time.perf_counter()
-        w = bound.epoch(w, ek)
-        jax.block_until_ready(w)
-        times.append(time.perf_counter() - t0)
-    epoch_s = float(np.median(times))
+        np.asarray(bound.multi_epoch(w0, key, n_ep))  # compile + warm (pull)
+        log(f"compile+first run ({n_ep} epochs): {time.perf_counter() - t0:.1f}s")
+        best = float("inf")
+        for _rep in range(3):
+            t0 = time.perf_counter()
+            np.asarray(bound.multi_epoch(w0, key, n_ep))
+            best = min(best, time.perf_counter() - t0)
+        times[n_ep] = best
+        log(f"best timed run ({n_ep} epochs): {best:.3f}s")
+    epoch_s = (times[3] - times[1]) / 2.0
+
+    # convergence sanity on real weights (outside the timed region)
+    w = bound.multi_epoch(w0, key, 3)
     loss, acc = bound.evaluate(w)
-    log(f"epoch times: {['%.3f' % t for t in times]}; loss={loss:.4f} acc={acc:.4f}")
+    log(f"epoch={epoch_s:.4f}s; after 3 epochs: loss={loss:.4f} acc={acc:.4f}")
     return epoch_s, loss, acc
 
 
-def baseline_epoch_seconds(idx, val, y, sample: int = 400) -> float:
-    """Reference-style per-sample boxed sparse-map gradient loop, timed on
-    `sample` samples and extrapolated to one epoch of n samples."""
+def _expected_w_nnz(batches_done: int) -> float:
+    """E[nnz(w)] after t batches: union of uniformly drawn feature ids
+    (each batch touches N_WORKERS*BATCH*NNZ draws)."""
+    draws = batches_done * N_WORKERS * BATCH * NNZ
+    return N_FEATURES * (1.0 - math.exp(-draws / N_FEATURES))
+
+
+def baseline_epoch_seconds(idx, val, y, sample: int = 400) -> dict:
+    """Model of one reference epoch (see module docstring)."""
     n = len(y)
     rows = [dict(zip(idx[i].tolist(), val[i].tolist())) for i in range(sample)]
+
+    # 1. worker compute: per-sample boxed backward (Slave.scala:147-152)
     w: dict = {}
     t0 = time.perf_counter()
     for i in range(sample):
         x = rows[i]
         margin = 0.0
-        for k, v in x.items():  # sparse dot (Sparse.scala:15-46)
-            margin += v * w.get(k, 0.0)
+        for k_, v in x.items():  # sparse dot (Sparse.scala:15-46)
+            margin += v * w.get(k_, 0.0)
         activity = y[i] * margin
         if activity >= 0:  # backward = y*x (SparseSVM.scala:26-29)
             yi = float(y[i])
-            for k, v in x.items():
-                w[k] = w.get(k, 0.0) - LR * yi * v
-    per_sample = (time.perf_counter() - t0) / sample
-    est = per_sample * n
-    log(f"baseline proxy: {per_sample*1e6:.1f}us/sample -> {est:.1f}s/epoch (python), "
-        f"{est/JVM_SPEEDUP:.1f}s (JVM conservative)")
-    return est / JVM_SPEEDUP
+            for k_, v in x.items():
+                w[k_] = w.get(k_, 0.0) - LR * yi * v
+    per_sample_py = (time.perf_counter() - t0) / sample
+    compute_s = per_sample_py * n / JVM_SPEEDUP / N_WORKERS  # workers in parallel
+
+    # 2. master reduce: mean of N_WORKERS sparse grads + update, per batch
+    grad_nnz = int(N_FEATURES * (1.0 - math.exp(-BATCH * NNZ / N_FEATURES)))
+    rng = np.random.default_rng(1)
+    worker_grads = [
+        dict(zip(rng.integers(0, N_FEATURES, grad_nnz).tolist(),
+                 rng.random(grad_nnz).tolist()))
+        for _ in range(N_WORKERS)
+    ]
+    t0 = time.perf_counter()
+    acc: dict = {}
+    for g in worker_grads:  # Vec.mean = fold of keyset-union merges
+        acc = {k2: acc.get(k2, 0.0) + g.get(k2, 0.0) for k2 in acc.keys() | g.keys()}
+    acc = {k2: v / N_WORKERS for k2, v in acc.items()}
+    reduce_per_batch_py = time.perf_counter() - t0
+    reduce_s = reduce_per_batch_py * STEPS_PER_EPOCH / JVM_SPEEDUP
+
+    # 3. wire codecs: exact byte count at a generous throughput
+    wire_bytes = 0.0
+    for t in range(STEPS_PER_EPOCH):
+        w_nnz = _expected_w_nnz(t)
+        w_bytes = w_nnz * BYTES_PER_ENTRY
+        g_bytes = grad_nnz * BYTES_PER_ENTRY
+        # master encodes w per worker + each worker decodes it;
+        # each worker encodes its reply + master decodes it
+        wire_bytes += N_WORKERS * (2 * w_bytes + 2 * g_bytes)
+    wire_s = wire_bytes / (WIRE_GBPS * 1e9)
+
+    total = compute_s + reduce_s + wire_s
+    log(
+        f"baseline model: compute {compute_s:.2f}s (py {per_sample_py*1e6:.1f}us/sample / "
+        f"{JVM_SPEEDUP:.0f} / {N_WORKERS} workers) + master-reduce {reduce_s:.2f}s "
+        f"(py {reduce_per_batch_py*1e3:.2f}ms/batch / {JVM_SPEEDUP:.0f}) + "
+        f"wire {wire_s:.2f}s ({wire_bytes/1e9:.2f} GB @ {WIRE_GBPS:.0f} GB/s) "
+        f"= {total:.2f}s/epoch"
+    )
+    return {
+        "total": total,
+        "compute": compute_s,
+        "reduce": reduce_s,
+        "wire": wire_s,
+    }
 
 
 def main() -> None:
@@ -125,20 +210,23 @@ def main() -> None:
     idx, val, y = gen_data(N_SAMPLES)
     log(f"generated in {time.perf_counter()-t0:.1f}s")
 
-    baseline_s = baseline_epoch_seconds(idx, val, y)
+    baseline = baseline_epoch_seconds(idx, val, y)
     epoch_s, loss, acc = tpu_epoch_seconds(idx, val, y)
 
     print(json.dumps({
         "metric": "rcv1_sync_epoch_seconds",
         "value": round(epoch_s, 4),
         "unit": "s",
-        "vs_baseline": round(baseline_s / epoch_s, 2),
+        "vs_baseline": round(baseline["total"] / epoch_s, 2),
         "final_loss": round(float(loss), 4),
         "final_acc": round(float(acc), 4),
-        "baseline_epoch_seconds_jvm_proxy": round(baseline_s, 2),
+        "baseline_epoch_seconds_jvm_model": round(baseline["total"], 2),
+        "baseline_breakdown_s": {k2: round(v, 2) for k2, v in baseline.items()},
         "n_samples": N_SAMPLES,
         "n_features": N_FEATURES,
         "batch_size": BATCH,
+        "n_workers": N_WORKERS,
+        "steps_per_epoch": STEPS_PER_EPOCH,
     }))
 
 

@@ -1,0 +1,148 @@
+"""Pallas TPU kernel: fused per-worker sparse-SVM-family gradient.
+
+The XLA one-hot MXU path (ops/mxu.py) is already ~4x faster than scalar
+scatter, but XLA materializes the [T, R] one-hot operand (~11 MB per step
+at RCV1 shapes) through HBM for each of the two matmuls.  This kernel
+fuses the whole worker gradient —
+
+    margins  m_b = x_b . w                (gather via one-hot MXU matmul)
+    coeff_b  = grad_coeff(m_b, y_b)       (hinge / logistic / lsq)
+    grad     g = sum_b coeff_b * x_b      (scatter via one-hot MXU matmul)
+
+— into one `pallas_call` per step: the blocked weights [R, 128] live in
+VMEM for the whole kernel, one-hot tiles are built in registers/VMEM per
+608-entry tile (8 samples x 76 nnz) and never touch HBM, and the gradient
+accumulates in a VMEM scratch.  Grid dimension = virtual workers K, so one
+launch produces every reference worker's Gradient reply
+(Slave.scala:142-153) for the step.
+
+The coefficient rule is passed as a static python function of
+(margins, labels) -> coeff, so every LinearModel subclass (models/linear.py)
+reuses the same kernel.  Labels are f32; padding rows carry y=0, val=0 and
+are inert through both phases (coeff(0-margin, y=0) may be nonzero for the
+hinge, but val=0 zeroes the scatter side).
+
+CPU/testing: pass interpret=True (tests/test_pallas_kernels.py) — the same
+kernel runs under the Pallas interpreter on the 8-device CPU mesh used by
+the test suite (SURVEY.md §4 strategy).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SAMPLE_TILE = 8  # samples per in-kernel tile (sublane-aligned)
+
+
+def _worker_grad_kernel(
+    idx_ref, val_ref, y_ref, w2_ref, g2_ref, g2_acc, m_scratch, *, coeff_fn
+):
+    """One grid step = one worker's fused gradient (see module docstring)."""
+    bp, p = idx_ref.shape[1], idx_ref.shape[2]
+    r = w2_ref.shape[0]
+    tt = SAMPLE_TILE * p
+    n_tiles = bp // SAMPLE_TILE
+
+    def onehots(t):
+        idxt = idx_ref[0, pl.ds(t * SAMPLE_TILE, SAMPLE_TILE), :]  # [8, P] i32
+        flat = idxt.reshape(tt, 1)
+        rows = flat // LANES
+        cols = flat % LANES
+        ohr = (
+            jax.lax.broadcasted_iota(jnp.int32, (tt, r), 1) == rows
+        ).astype(jnp.float32)
+        ohc = (
+            jax.lax.broadcasted_iota(jnp.int32, (tt, LANES), 1) == cols
+        ).astype(jnp.float32)
+        valt = val_ref[0, pl.ds(t * SAMPLE_TILE, SAMPLE_TILE), :].reshape(tt, 1)
+        return ohr, ohc, valt
+
+    # phase 1: margins
+    for t in range(n_tiles):
+        ohr, ohc, valt = onehots(t)
+        m1 = jax.lax.dot_general(
+            ohr, w2_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [tt, 128]
+        gathered = jnp.sum(m1 * ohc, axis=-1, keepdims=True) * valt  # [tt, 1]
+        m_scratch[pl.ds(t * SAMPLE_TILE, SAMPLE_TILE), :] = gathered.reshape(
+            SAMPLE_TILE, p
+        ).sum(axis=-1, keepdims=True)
+
+    # coefficient rule (static python fn; traced into the kernel)
+    margins = m_scratch[:, 0].reshape(bp, 1)
+    yb = y_ref[0, :].reshape(bp, 1)
+    coeff = coeff_fn(margins, yb)  # [bp, 1]
+
+    # phase 2: scatter-accumulate
+    g2_acc[:] = jnp.zeros_like(g2_acc)
+    for t in range(n_tiles):
+        ohr, ohc, valt = onehots(t)
+        ct = coeff[pl.ds(t * SAMPLE_TILE, SAMPLE_TILE), :]  # [8, 1]
+        cv = (jnp.broadcast_to(ct, (SAMPLE_TILE, p)).reshape(tt, 1)) * valt
+        contrib = ohc * cv  # [tt, 128]
+        g2_acc[:] += jax.lax.dot_general(
+            ohr, contrib, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [r, 128]
+    g2_ref[0, :, :] = g2_acc[:]
+
+
+def pad_batch(idx: jax.Array, val: jax.Array, y: jax.Array):
+    """Pad the per-worker batch dim to a SAMPLE_TILE multiple with inert
+    rows (idx 0, val 0, y 0)."""
+    k, b, p = idx.shape
+    bp = -(-b // SAMPLE_TILE) * SAMPLE_TILE
+    if bp == b:
+        return idx, val, y
+    pad = ((0, 0), (0, bp - b), (0, 0))
+    return (
+        jnp.pad(idx, pad),
+        jnp.pad(val, pad),
+        jnp.pad(y, ((0, 0), (0, bp - b))),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("coeff_fn", "interpret"))
+def worker_grads(
+    w2: jax.Array,
+    idx: jax.Array,
+    val: jax.Array,
+    y: jax.Array,
+    coeff_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused gradients for K workers: [K, R, 128] from idx/val/y [K, B, P].
+
+    coeff_fn(margins, labels) -> per-sample gradient coefficient, applied
+    on [B, 1] arrays inside the kernel (e.g. SparseSVM.grad_coeff).
+    """
+    idx, val, y = pad_batch(idx, val.astype(jnp.float32), y.astype(jnp.float32))
+    k, bp, p = idx.shape
+    r, lanes = w2.shape
+    assert lanes == LANES
+    kernel = functools.partial(_worker_grad_kernel, coeff_fn=coeff_fn)
+    return pl.pallas_call(
+        kernel,
+        grid=(k,),
+        in_specs=[
+            pl.BlockSpec((1, bp, p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bp, p), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bp), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((r, LANES), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, r, LANES), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((k, r, LANES), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((r, LANES), jnp.float32),
+            pltpu.VMEM((bp, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(idx, val, y, w2)
