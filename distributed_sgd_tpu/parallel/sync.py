@@ -17,12 +17,15 @@ The whole epoch is ONE compiled program: no host round-trips, no
 serialization of the 47k-dim weight vector per batch per worker (the
 reference ships it over gRPC every batch, Master.scala:184-189).
 
-Two kernel backends (`kernel=`): 'mxu' (default) keeps weights in the
+Three kernel backends (`kernel=`): 'mxu' (default) keeps weights in the
 lane-blocked [R, 128] view across the epoch scan and runs the sparse
 gather/scatter as one-hot MXU matmuls (ops/mxu.py, ~4x faster per step at
-RCV1 shapes); 'scalar' is the reference-shaped take/scatter path
-(ops/sparse.py).  Both produce identical updates up to float summation
-order (tests/test_mxu_kernels.py).
+RCV1 shapes); 'pallas' is the hand-fused single-launch version of the
+same formulation (ops/pallas_sparse.py — measured within ~30% of 'mxu' on
+v5e, kept as a first-class backend and the starting point for shapes
+where fusion wins); 'scalar' is the reference-shaped take/scatter path
+(ops/sparse.py).  All produce identical updates up to float summation
+order (tests/test_mxu_kernels.py, tests/test_pallas_kernels.py).
 
 Batch sampling mirrors Master.scala:184 (`split.map(Random.shuffle(_))`
 then slice): every step each worker draws a fresh uniform batch from its
@@ -80,9 +83,16 @@ class BoundSync:
     ):
         if sampling not in ("fresh", "epoch"):
             raise ValueError(f"sampling must be 'fresh' or 'epoch', got {sampling!r}")
-        if kernel not in ("mxu", "scalar"):
-            raise ValueError(f"kernel must be 'mxu' or 'scalar', got {kernel!r}")
+        if kernel not in ("mxu", "scalar", "pallas"):
+            raise ValueError(
+                f"kernel must be 'mxu', 'scalar' or 'pallas', got {kernel!r}"
+            )
         self.kernel = kernel
+        # the Pallas kernel needs the interpreter off-TPU (tests, CPU mesh),
+        # and the interpreter cannot type varying-mesh-axes (vma) through its
+        # grid emulation, so vma checking is disabled for that backend
+        self._pallas_interpret = jax.default_backend() != "tpu"
+        self._check_vma = not (kernel == "pallas" and self._pallas_interpret)
         self.model = model
         self.mesh = mesh
         self.data = data
@@ -119,6 +129,7 @@ class BoundSync:
                 mesh=mesh,
                 in_specs=(P(),) + dspec + (P(),),
                 out_specs=P(),
+                check_vma=self._check_vma,
             )
         )
         self._step = jax.jit(
@@ -127,6 +138,7 @@ class BoundSync:
                 mesh=mesh,
                 in_specs=(P(),) + dspec + (P(),),
                 out_specs=P(),
+                check_vma=self._check_vma,
             )
         )
         self._eval = jax.jit(
@@ -135,6 +147,7 @@ class BoundSync:
                 mesh=mesh,
                 in_specs=(P(),) + dspec,
                 out_specs=P(),
+                check_vma=self._check_vma,
             )
         )
         self._predict = jax.jit(
@@ -143,6 +156,7 @@ class BoundSync:
                 mesh=mesh,
                 in_specs=(P(),) + dspec[:2],
                 out_specs=P(AXIS),
+                check_vma=self._check_vma,
             )
         )
 
@@ -173,9 +187,18 @@ class BoundSync:
 
     def _one_step(self, w, idx, val, y, key, step):
         """One sync DP step on weights in the kernel's native layout:
-        dense [D] for 'scalar', lane-blocked [R, 128] for 'mxu'."""
+        dense [D] for 'scalar', lane-blocked [R, 128] for 'mxu'/'pallas'."""
         ids = self._sample_ids(key, step)  # [K, B]
-        if self.virtual_workers == 1:
+        if self.kernel == "pallas":
+            from distributed_sgd_tpu.ops import pallas_sparse
+
+            gk = pallas_sparse.worker_grads(
+                w, idx[ids], val[ids], y[ids], self.model.grad_coeff,
+                interpret=self._pallas_interpret,
+            )  # [K, R, 128], one fused launch for every worker
+            gk = jax.vmap(lambda g: self.model.regularize_blocked(g, w))(gk)
+            g = jnp.sum(gk, axis=0)
+        elif self.virtual_workers == 1:
             g = self._worker_grad(w, SparseBatch(idx[ids[0]], val[ids[0]]), y[ids[0]])
         else:
             gk = jax.vmap(
@@ -186,13 +209,17 @@ class BoundSync:
         g = jax.lax.psum(g, AXIS) / (self.n_workers * self.virtual_workers)
         return w - self.learning_rate * g
 
+    @property
+    def _blocked_layout(self) -> bool:
+        return self.kernel in ("mxu", "pallas")
+
     def _to_kernel_layout(self, w):
-        if self.kernel == "mxu":
+        if self._blocked_layout:
             return mxu.to_blocked(w, self.model.n_features)
         return w
 
     def _from_kernel_layout(self, w):
-        if self.kernel == "mxu":
+        if self._blocked_layout:
             return mxu.from_blocked(w, self.model.n_features)
         return w
 
@@ -287,6 +314,7 @@ class BoundSync:
                     mesh=self.mesh,
                     in_specs=(P(),) + (P(AXIS), P(AXIS), P(AXIS)) + (P(),),
                     out_specs=P(),
+                    check_vma=self._check_vma,
                 )
             )
         return self._multi_cache[n_epochs](
