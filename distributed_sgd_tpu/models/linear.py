@@ -85,7 +85,12 @@ class LinearModel:
 
     def sample_losses(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
         """Per-sample losses (no regularization term), vectorized."""
-        return self.sample_loss(self.forward(w, batch), y)
+        return self.losses_from_margins(self.margins(w, batch), y)
+
+    def losses_from_margins(self, margins: jax.Array, y: jax.Array) -> jax.Array:
+        """Per-sample losses given precomputed margins — lets eval paths
+        compute margins with whichever kernel fits the weight layout."""
+        return self.sample_loss(self.predict(margins), y)
 
     def forward(self, w: jax.Array, batch: SparseBatch) -> jax.Array:
         return self.predict(self.margins(w, batch))
@@ -194,13 +199,12 @@ class LogisticRegression(LinearModel):
         return jnp.where(margins >= 0, 1.0, -1.0)
 
     def sample_loss(self, preds: jax.Array, y: jax.Array) -> jax.Array:
-        del preds  # logistic loss is margin-based; see sample_losses
-        raise NotImplementedError("use sample_losses()/objective()")
+        del preds  # logistic loss is margin-based; see losses_from_margins
+        raise NotImplementedError("use losses_from_margins()/objective()")
 
-    def sample_losses(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
-        m = self.margins(w, batch)
+    def losses_from_margins(self, margins: jax.Array, y: jax.Array) -> jax.Array:
         yf = y.astype(jnp.float32)
-        return jnp.logaddexp(0.0, -yf * m)  # log(1 + exp(-y m)), stable
+        return jnp.logaddexp(0.0, -yf * margins)  # log(1 + exp(-y m)), stable
 
     def objective(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
         reg = self.lam * jnp.sum(w.astype(jnp.float32) ** 2)

@@ -240,11 +240,34 @@ class BoundSync:
             self._one_step(w, idx, val, y, key, jnp.int32(0))
         )
 
+    def _chunk_margins(self, w_layout, batch: SparseBatch) -> jax.Array:
+        """Per-sample margins with the kernel matching the weight layout.
+
+        The blocked path computes the gather as one-hot MXU matmuls over a
+        512-sample sub-scan (bounds the [T, R] one-hot working set while
+        keeping matmuls large); the scalar path is a plain take-gather.
+        """
+        if not self._blocked_layout:
+            return self.model.margins(w_layout, batch)
+        sub = 512
+        n = batch.batch_size
+        if n <= sub or n % sub != 0:
+            return mxu.matvec(batch, w_layout)
+
+        def body(_, t):
+            ci = jax.lax.dynamic_slice_in_dim(batch.indices, t * sub, sub, 0)
+            cv = jax.lax.dynamic_slice_in_dim(batch.values, t * sub, sub, 0)
+            return (), mxu.matvec(SparseBatch(ci, cv), w_layout)
+
+        _, m = jax.lax.scan(body, (), jnp.arange(n // sub))
+        return m.reshape(-1)
+
     def _eval_shard(self, w, idx, val, y) -> Tuple[jax.Array, jax.Array]:
         # chunked scan so the working set stays small; pads (label 0) masked;
         # bind() padded each shard to a multiple of eval_chunk
         chunk = self.eval_chunk
         n_chunks = self.shard_n // chunk
+        w_layout = self._to_kernel_layout(w)
 
         def body(acc, t):
             loss_acc, hit_acc = acc
@@ -253,9 +276,9 @@ class BoundSync:
             cv = jax.lax.dynamic_slice_in_dim(val, s, chunk, 0)
             cy = jax.lax.dynamic_slice_in_dim(y, s, chunk, 0)
             mask = (cy != 0).astype(jnp.float32)
-            batch = SparseBatch(ci, cv)
-            losses = self.model.sample_losses(w, batch, cy)
-            preds = self.model.forward(w, batch)
+            margins = self._chunk_margins(w_layout, SparseBatch(ci, cv))
+            losses = self.model.losses_from_margins(margins, cy)
+            preds = self.model.predict(margins)
             hits = (preds == cy.astype(jnp.float32)).astype(jnp.float32)
             return (loss_acc + jnp.sum(losses * mask), hit_acc + jnp.sum(hits * mask)), ()
 
@@ -266,12 +289,15 @@ class BoundSync:
     def _predict_shard(self, w, idx, val) -> jax.Array:
         chunk = self.eval_chunk
         n_chunks = self.shard_n // chunk
+        w_layout = self._to_kernel_layout(w)
 
         def body(_, t):
             s = t * chunk
             ci = jax.lax.dynamic_slice_in_dim(idx, s, chunk, 0)
             cv = jax.lax.dynamic_slice_in_dim(val, s, chunk, 0)
-            return (), self.model.forward(w, SparseBatch(ci, cv))
+            return (), self.model.predict(
+                self._chunk_margins(w_layout, SparseBatch(ci, cv))
+            )
 
         _, preds = jax.lax.scan(body, (), jnp.arange(n_chunks))
         return preds.reshape(-1)
