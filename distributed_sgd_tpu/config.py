@@ -61,6 +61,8 @@ class Config:
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     profile_dir: Optional[str] = None  # jax.profiler trace output
     pad_width: Optional[int] = None  # sparse-batch nnz padding (None = auto)
+    kernel: str = "mxu"  # mxu | scalar | pallas (sync-engine sparse kernels)
+    virtual_workers: int = 1  # reference workers emulated per mesh device
 
     @property
     def role(self) -> str:
@@ -101,6 +103,8 @@ class Config:
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),
             pad_width=_env("DSGD_PAD_WIDTH", None, int),
+            kernel=_env("DSGD_KERNEL", cls.kernel, str),
+            virtual_workers=_env("DSGD_VIRTUAL_WORKERS", cls.virtual_workers, int),
         )
         return dataclasses.replace(cfg, **overrides)
 

@@ -59,8 +59,13 @@ class SyncTrainer:
         profile_dir: Optional[str] = None,
         checkpointer=None,
         checkpoint_every: int = 1,
+        kernel: str = "mxu",
+        virtual_workers: int = 1,
     ):
-        self.engine = SyncEngine(model, mesh, batch_size, learning_rate, sampling=sampling)
+        self.engine = SyncEngine(
+            model, mesh, batch_size, learning_rate, sampling=sampling,
+            kernel=kernel, virtual_workers=virtual_workers,
+        )
         self.model = model
         self.metrics = metrics or metrics_mod.global_metrics()
         self.seed = seed

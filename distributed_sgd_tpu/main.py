@@ -71,8 +71,16 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
 
     n = min(cfg.node_count, len(jax.devices()))
     mesh = make_mesh(n)
+    # cover the full reference worker count even on fewer chips: remaining
+    # workers are emulated per device (parallel/sync.py virtual_workers)
+    virtual = cfg.virtual_workers
+    if virtual == 1 and cfg.node_count > n:
+        virtual = -(-cfg.node_count // n)
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
-    log.info("engine=mesh devices=%d model=%s async=%s", n, cfg.model, cfg.use_async)
+    log.info(
+        "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
+        n, virtual, cfg.kernel, cfg.model, cfg.use_async,
+    )
 
     if cfg.use_async and cfg.async_mode == "gossip":
         from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
@@ -90,6 +98,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             model, mesh, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, sync_period=cfg.sync_period,
             check_every=cfg.check_every, leaky_loss=cfg.leaky_loss, seed=cfg.seed,
+            kernel="scalar" if cfg.kernel == "scalar" else "mxu",
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion)
     else:
@@ -98,6 +107,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
         trainer = SyncTrainer(
             model, mesh, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, seed=cfg.seed,
+            kernel=cfg.kernel, virtual_workers=virtual,
         )
         res = trainer.fit(train, test, cfg.max_epochs, criterion)
 

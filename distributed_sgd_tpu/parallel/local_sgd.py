@@ -34,6 +34,7 @@ from distributed_sgd_tpu.core.loss_check import LossChecker
 from distributed_sgd_tpu.core.trainer import FitResult
 from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
+from distributed_sgd_tpu.ops import mxu
 from distributed_sgd_tpu.ops.sparse import SparseBatch
 from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS as AXIS
 from distributed_sgd_tpu.parallel.sync import SyncEngine
@@ -54,9 +55,13 @@ class LocalSGDEngine:
         leaky_loss: float = 0.9,
         seed: int = 0,
         metrics: Optional[metrics_mod.Metrics] = None,
+        kernel: str = "mxu",
     ):
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
+        if kernel not in ("mxu", "scalar"):
+            raise ValueError(f"kernel must be 'mxu' or 'scalar', got {kernel!r}")
+        self.kernel = kernel
         self.model = model
         self.mesh = mesh
         self.batch_size = int(batch_size)
@@ -84,18 +89,27 @@ class LocalSGDEngine:
         bs, lr, h = self.batch_size, self.learning_rate, self.sync_period
         model = self.model
 
+        blocked = self.kernel == "mxu"
+        n_features = model.n_features
+
         def round_shard(w, idx, val, y, key):
             key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
+            if blocked:
+                w = mxu.to_blocked(w, n_features)
 
             def body(wl, t):
                 ids = jax.random.randint(jax.random.fold_in(key, t), (bs,), 0, shard_n)
                 batch = SparseBatch(idx[ids], val[ids])
+                if blocked:
+                    g = model.grad_blocked(wl, batch, y[ids], reduce="mean")
+                    return wl - lr * model.regularize_blocked(g, wl), ()
                 g = model.grad_mean(wl, batch, y[ids])
                 return wl - lr * model.regularize(g, wl), ()
 
             w_var = jax.lax.pcast(w, (AXIS,), to="varying")  # replicas diverge
             wl, _ = jax.lax.scan(body, w_var, jnp.arange(h))
-            return jax.lax.pmean(wl, AXIS)  # the gossip, collapsed
+            wl = jax.lax.pmean(wl, AXIS)  # the gossip, collapsed
+            return mxu.from_blocked(wl, n_features) if blocked else wl
 
         round_fn = jax.jit(
             jax.shard_map(
