@@ -57,33 +57,54 @@ class Dataset:
         return Dataset(self.indices[sel], self.values[sel], self.labels[sel], self.n_features)
 
 
-def parse_svm_file_py(path: str, index_offset: int = -1):
+def _parse_chunk(lines: List[str], index_offset: int):
+    doc_ids: List[int] = []
+    row_nnz: List[int] = []
+    cols: List[int] = []
+    vals: List[float] = []
+    for line in lines:
+        parts = line.split()
+        if not parts:
+            continue
+        doc_ids.append(int(parts[0]))
+        n = 0
+        for tok in parts[1:]:
+            if ":" not in tok:
+                continue
+            k, v = tok.split(":", 1)
+            cols.append(int(k) + index_offset)
+            vals.append(float(v))
+            n += 1
+        row_nnz.append(n)
+    return doc_ids, row_nnz, cols, vals
+
+
+def parse_svm_file_py(path: str, index_offset: int = -1, chunk: int = 4096):
     """Pure-python fallback parser -> (doc_ids, row_ptr, col_idx, values).
 
     Same format handling as the reference (Dataset.scala:19-34): first token
     is the doc id, remaining `f:v` tokens are features (the reference's
     `drop(2)` skips the empty token from the double space after the id;
-    we split on arbitrary whitespace instead).
+    we split on arbitrary whitespace instead).  Chunked over the shared
+    FixedPool like the reference's `.grouped(4096).par`
+    (Dataset.scala:21-22, utils/Pool.scala).
     """
+    from distributed_sgd_tpu.utils.pool import global_pool
+
+    with open(path, "r") as f:
+        lines = f.readlines()
+    chunks = [lines[i : i + chunk] for i in range(0, len(lines), chunk)]
+    parsed = global_pool().map(lambda c: _parse_chunk(c, index_offset), chunks)
+
     doc_ids: List[int] = []
     row_nnz: List[int] = []
     cols: List[int] = []
     vals: List[float] = []
-    with open(path, "r") as f:
-        for line in f:
-            parts = line.split()
-            if not parts:
-                continue
-            doc_ids.append(int(parts[0]))
-            n = 0
-            for tok in parts[1:]:
-                if ":" not in tok:
-                    continue
-                k, v = tok.split(":", 1)
-                cols.append(int(k) + index_offset)
-                vals.append(float(v))
-                n += 1
-            row_nnz.append(n)
+    for d, n, c, v in parsed:
+        doc_ids.extend(d)
+        row_nnz.extend(n)
+        cols.extend(c)
+        vals.extend(v)
     row_ptr = np.zeros(len(doc_ids) + 1, dtype=np.int64)
     np.cumsum(row_nnz, out=row_ptr[1:])
     return (

@@ -1,0 +1,38 @@
+"""utils/pool.py — the reference Pool.scala equivalent."""
+
+import time
+
+from distributed_sgd_tpu.utils.metrics import Metrics
+from distributed_sgd_tpu.utils.pool import FixedPool, await_result, global_pool
+
+
+def test_submit_and_await():
+    m = Metrics()
+    with FixedPool(n_workers=4, name="testpool", metrics=m) as pool:
+        futs = [pool.submit(lambda i=i: i * i) for i in range(10)]
+        got = sorted(await_result(f) for f in futs)
+    assert got == [i * i for i in range(10)]
+    assert m.counter("testpool.submitted").value == 10
+    assert m.counter("testpool.completed").value == 10
+
+
+def test_map_preserves_order():
+    with FixedPool(n_workers=3) as pool:
+        def slow_id(x):
+            time.sleep(0.01 * (x % 3))
+            return x
+        assert pool.map(slow_id, range(9)) == list(range(9))
+
+
+def test_await_propagates_exception():
+    with FixedPool(n_workers=1) as pool:
+        f = pool.submit(lambda: 1 / 0)
+        try:
+            await_result(f)
+            raise AssertionError("expected ZeroDivisionError")
+        except ZeroDivisionError:
+            pass
+
+
+def test_global_pool_singleton():
+    assert global_pool() is global_pool()
