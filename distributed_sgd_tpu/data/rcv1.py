@@ -57,54 +57,37 @@ class Dataset:
         return Dataset(self.indices[sel], self.values[sel], self.labels[sel], self.n_features)
 
 
-def _parse_chunk(lines: List[str], index_offset: int):
-    doc_ids: List[int] = []
-    row_nnz: List[int] = []
-    cols: List[int] = []
-    vals: List[float] = []
-    for line in lines:
-        parts = line.split()
-        if not parts:
-            continue
-        doc_ids.append(int(parts[0]))
-        n = 0
-        for tok in parts[1:]:
-            if ":" not in tok:
-                continue
-            k, v = tok.split(":", 1)
-            cols.append(int(k) + index_offset)
-            vals.append(float(v))
-            n += 1
-        row_nnz.append(n)
-    return doc_ids, row_nnz, cols, vals
-
-
-def parse_svm_file_py(path: str, index_offset: int = -1, chunk: int = 4096):
+def parse_svm_file_py(path: str, index_offset: int = -1):
     """Pure-python fallback parser -> (doc_ids, row_ptr, col_idx, values).
 
     Same format handling as the reference (Dataset.scala:19-34): first token
     is the doc id, remaining `f:v` tokens are features (the reference's
     `drop(2)` skips the empty token from the double space after the id;
-    we split on arbitrary whitespace instead).  Chunked over the shared
-    FixedPool like the reference's `.grouped(4096).par`
-    (Dataset.scala:21-22, utils/Pool.scala).
+    we split on arbitrary whitespace instead).  Streams line by line: a
+    GIL-bound thread pool buys nothing for pure-python parsing, so the
+    reference's chunk parallelism (.grouped(4096).par, Dataset.scala:21-22)
+    lives in the native parser's threads and load_rcv1's per-file pool
+    fan-out instead.
     """
-    from distributed_sgd_tpu.utils.pool import global_pool
-
-    with open(path, "r") as f:
-        lines = f.readlines()
-    chunks = [lines[i : i + chunk] for i in range(0, len(lines), chunk)]
-    parsed = global_pool().map(lambda c: _parse_chunk(c, index_offset), chunks)
-
     doc_ids: List[int] = []
     row_nnz: List[int] = []
     cols: List[int] = []
     vals: List[float] = []
-    for d, n, c, v in parsed:
-        doc_ids.extend(d)
-        row_nnz.extend(n)
-        cols.extend(c)
-        vals.extend(v)
+    with open(path, "r") as f:
+        for line in f:
+            parts = line.split()
+            if not parts:
+                continue
+            doc_ids.append(int(parts[0]))
+            n = 0
+            for tok in parts[1:]:
+                if ":" not in tok:
+                    continue
+                k, v = tok.split(":", 1)
+                cols.append(int(k) + index_offset)
+                vals.append(float(v))
+                n += 1
+            row_nnz.append(n)
     row_ptr = np.zeros(len(doc_ids) + 1, dtype=np.int64)
     np.cumsum(row_nnz, out=row_ptr[1:])
     return (
@@ -213,7 +196,19 @@ def load_rcv1(
         files += [os.path.join(folder, f"lyrl2004_vectors_test_pt{d}.dat") for d in range(4)]
     labels_map = read_labels(os.path.join(folder, "rcv1-v2.topics.qrels"))
 
-    parts = [parse_svm_file(f, n_threads=n_threads) for f in files]
+    # per-file fan-out on the shared pool: the native parser releases the
+    # GIL inside the ctypes call, so the 5 `full` files parse concurrently
+    # (the reference's .par chunk parallelism, one level up).  Split the
+    # core budget across files so n_threads=0 (auto = all cores per call)
+    # doesn't oversubscribe 5x.
+    from distributed_sgd_tpu.utils.pool import global_pool
+
+    per_file_threads = n_threads
+    if per_file_threads == 0 and len(files) > 1:
+        per_file_threads = max(1, (os.cpu_count() or 1) // len(files))
+    parts = global_pool().map(
+        lambda f: parse_svm_file(f, n_threads=per_file_threads), files
+    )
     doc_ids = np.concatenate([p[0] for p in parts])
     col_idx = np.concatenate([p[2] for p in parts])
     values = np.concatenate([p[3] for p in parts])

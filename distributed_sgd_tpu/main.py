@@ -69,13 +69,25 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     """Dev-mode fast path: in-mesh engines, no RPC data plane."""
     from distributed_sgd_tpu.parallel.mesh import make_mesh
 
-    n = min(cfg.node_count, len(jax.devices()))
-    mesh = make_mesh(n)
     # cover the full reference worker count even on fewer chips: remaining
-    # workers are emulated per device (parallel/sync.py virtual_workers)
+    # workers are emulated per device (parallel/sync.py virtual_workers).
+    # Keep the total EXACTLY node_count: use the largest device count that
+    # divides it, so mesh_workers * virtual == node_count always.
+    n_max = min(cfg.node_count, len(jax.devices()))
     virtual = cfg.virtual_workers
-    if virtual == 1 and cfg.node_count > n:
-        virtual = -(-cfg.node_count // n)
+    if virtual == 1 and cfg.node_count > n_max:
+        n = max(d for d in range(1, n_max + 1) if cfg.node_count % d == 0)
+        virtual = cfg.node_count // n
+        if n < n_max:
+            log.warning(
+                "node_count=%d has no divisor <= %d devices; running exact "
+                "topology on %d device(s) (%d idle) — pick a node_count "
+                "divisible by the device count for full throughput",
+                cfg.node_count, n_max, n, n_max - n,
+            )
+    else:
+        n = n_max
+    mesh = make_mesh(n)
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
     log.info(
         "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
@@ -94,11 +106,15 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     elif cfg.use_async:
         from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
 
+        kernel = cfg.kernel
+        if kernel == "pallas":
+            log.warning("local_sgd does not support kernel=pallas; using mxu")
+            kernel = "mxu"
         eng = LocalSGDEngine(
             model, mesh, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, sync_period=cfg.sync_period,
             check_every=cfg.check_every, leaky_loss=cfg.leaky_loss, seed=cfg.seed,
-            kernel="scalar" if cfg.kernel == "scalar" else "mxu",
+            kernel=kernel,
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion)
     else:

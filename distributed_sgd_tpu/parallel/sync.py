@@ -318,9 +318,20 @@ class BoundSync:
         w, _ = jax.lax.scan(epoch_body, w, jnp.arange(n_epochs))
         return self._from_kernel_layout(w)
 
+    def _check_trainable(self) -> None:
+        """Checked at train-call time, not bind time: an eval-only binding
+        (e.g. the test split) never samples batches."""
+        if self.sampling == "epoch" and self.virtual_workers * self.batch_size > self.shard_n:
+            raise ValueError(
+                f"sampling='epoch' needs virtual_workers*batch_size "
+                f"({self.virtual_workers}*{self.batch_size}) <= per-device shard "
+                f"({self.shard_n}); lower the batch size or worker count"
+            )
+
     # -- host API ----------------------------------------------------------
 
     def epoch(self, w: jax.Array, key: jax.Array) -> jax.Array:
+        self._check_trainable()
         return self._epoch(w, self.data.indices, self.data.values, self.data.labels, key)
 
     def multi_epoch(self, w: jax.Array, key: jax.Array, n_epochs: int) -> jax.Array:
@@ -331,6 +342,7 @@ class BoundSync:
         long headless runs."""
         if not hasattr(self, "_multi_cache"):
             self._multi_cache = {}
+        self._check_trainable()
         if n_epochs not in self._multi_cache:
             import functools
 
@@ -348,6 +360,7 @@ class BoundSync:
         )
 
     def step(self, w: jax.Array, key: jax.Array) -> jax.Array:
+        self._check_trainable()
         return self._step(w, self.data.indices, self.data.values, self.data.labels, key)
 
     def predict(self, w: jax.Array) -> np.ndarray:
