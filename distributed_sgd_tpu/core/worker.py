@@ -157,24 +157,32 @@ class WorkerNode:
 
     # -- compiled kernels --------------------------------------------------
 
-    def _grad_fn(self, capacity: int, kind: str):
-        """kind: 'sum' (sync Gradient RPC) or 'mean' (async step)."""
+    def _grad_fn(self, capacity: int):
+        """Sync Gradient RPC body (sum + regularize), jitted per capacity.
+
+        On a TPU-pinned worker the body runs on the lane-blocked MXU path
+        (ops/mxu.py, the same kernels as the mesh engines); on CPU workers
+        the scalar gather/scatter is faster than one-hot matmuls, so it
+        stays.  The async step compiles its own mean-reduced variant
+        (_async_loop).
+        """
         model = self.model
-        key = (capacity, kind)
-        if key not in self._grad_cache:
+        blocked = self._blocked_device()
+        if capacity not in self._grad_cache:
 
             def fn(w, idx, val, y, ids, valid):
                 rows_i = idx[ids]
                 rows_v = val[ids] * valid[:, None]  # zero rows for pads
                 batch = SparseBatch(rows_i, rows_v)
                 by = y[ids] * valid.astype(y.dtype)
-                g = model.grad_sum(w, batch, by)
-                if kind == "mean":
-                    g = g / jnp.maximum(jnp.sum(valid), 1.0)
-                return model.regularize(g, w)
+                return model.grad_regularized(w, batch, by, blocked=blocked)
 
-            self._grad_cache[key] = jax.jit(fn)
-        return self._grad_cache[key]
+            self._grad_cache[capacity] = jax.jit(fn)
+        return self._grad_cache[capacity]
+
+    def _blocked_device(self) -> bool:
+        """Blocked MXU kernels pay off on this worker's pinned device?"""
+        return getattr(self.device, "platform", jax.default_backend()) == "tpu"
 
     def _pad_ids(self, ids: np.ndarray) -> Tuple[jax.Array, jax.Array]:
         cap = _next_pow2(len(ids))
@@ -188,7 +196,7 @@ class WorkerNode:
         """Sync Gradient RPC body: sum of backwards + regularize
         (Slave.scala:142-157)."""
         pids, valid = self._pad_ids(ids)
-        g = self._grad_fn(len(pids), "sum")(
+        g = self._grad_fn(len(pids))(
             jnp.asarray(w), self._idx, self._val, self._y, pids, valid
         )
         self.metrics.counter("slave.sync.backward").increment()
@@ -237,11 +245,15 @@ class WorkerNode:
         n_assigned = int(self._assignment.shape[0])
         model = self.model
 
+        blocked = self._blocked_device()
+
         def step(w, assignment, idx, val, y, key):
             ids = assignment[jax.random.randint(key, (bs,), 0, n_assigned)]
             batch = SparseBatch(idx[ids], val[ids])
-            g = model.grad_mean(w, batch, y[ids])  # MEAN (Slave.scala:93-98)
-            return lr * model.regularize(g, w)
+            # MEAN reduce (Slave.scala:93-98) + regularize (Slave.scala:99)
+            return lr * model.grad_regularized(
+                w, batch, y[ids], reduce="mean", blocked=blocked
+            )
 
         step = jax.jit(step)
         key = jax.random.PRNGKey(self.seed + self.port)

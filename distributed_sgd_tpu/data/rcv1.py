@@ -196,19 +196,22 @@ def load_rcv1(
         files += [os.path.join(folder, f"lyrl2004_vectors_test_pt{d}.dat") for d in range(4)]
     labels_map = read_labels(os.path.join(folder, "rcv1-v2.topics.qrels"))
 
-    # per-file fan-out on the shared pool: the native parser releases the
-    # GIL inside the ctypes call, so the 5 `full` files parse concurrently
-    # (the reference's .par chunk parallelism, one level up).  Split the
-    # core budget across files so n_threads=0 (auto = all cores per call)
-    # doesn't oversubscribe 5x.
-    from distributed_sgd_tpu.utils.pool import global_pool
+    # With auto threading (n_threads=0) and several files, fan out one parse
+    # per file on the shared pool — the native parser releases the GIL
+    # inside the ctypes call, so files parse concurrently (the reference's
+    # .par chunk parallelism, one level up) — and split the core budget so
+    # concurrent parses don't oversubscribe.  An EXPLICIT n_threads is a
+    # per-parse budget: honor it with sequential parses.
+    cores = os.cpu_count() or 1
+    if n_threads == 0 and len(files) > 1 and cores >= 2 * len(files):
+        from distributed_sgd_tpu.utils.pool import global_pool
 
-    per_file_threads = n_threads
-    if per_file_threads == 0 and len(files) > 1:
-        per_file_threads = max(1, (os.cpu_count() or 1) // len(files))
-    parts = global_pool().map(
-        lambda f: parse_svm_file(f, n_threads=per_file_threads), files
-    )
+        per_file = cores // len(files)
+        parts = global_pool().map(
+            lambda f: parse_svm_file(f, n_threads=per_file), files
+        )
+    else:
+        parts = [parse_svm_file(f, n_threads=n_threads) for f in files]
     doc_ids = np.concatenate([p[0] for p in parts])
     col_idx = np.concatenate([p[2] for p in parts])
     values = np.concatenate([p[3] for p in parts])

@@ -69,21 +69,24 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     """Dev-mode fast path: in-mesh engines, no RPC data plane."""
     from distributed_sgd_tpu.parallel.mesh import make_mesh
 
-    # cover the full reference worker count even on fewer chips: remaining
-    # workers are emulated per device (parallel/sync.py virtual_workers).
-    # Keep the total EXACTLY node_count: use the largest device count that
-    # divides it, so mesh_workers * virtual == node_count always.
+    # SYNC path: cover the full reference worker count even on fewer chips —
+    # remaining workers are emulated per device (parallel/sync.py
+    # virtual_workers).  Keep the total EXACTLY node_count: use the largest
+    # device count that divides it, so mesh_workers * virtual == node_count.
+    # Async engines ignore virtual_workers, so they always get the full
+    # device mesh (n_max) instead of the divisor-shrunk one.
     n_max = min(cfg.node_count, len(jax.devices()))
     virtual = cfg.virtual_workers
-    if virtual == 1 and cfg.node_count > n_max:
+    if not cfg.use_async and virtual == 1 and cfg.node_count > n_max:
         n = max(d for d in range(1, n_max + 1) if cfg.node_count % d == 0)
         virtual = cfg.node_count // n
         if n < n_max:
             log.warning(
-                "node_count=%d has no divisor <= %d devices; running exact "
-                "topology on %d device(s) (%d idle) — pick a node_count "
-                "divisible by the device count for full throughput",
-                cfg.node_count, n_max, n, n_max - n,
+                "node_count=%d is not divisible by any device count <= %d; "
+                "running the exact %d-worker topology on %d device(s) "
+                "(%d idle) — pick a node_count divisible by the device "
+                "count for full throughput",
+                cfg.node_count, n_max, cfg.node_count, n, n_max - n,
             )
     else:
         n = n_max

@@ -162,6 +162,26 @@ class LinearModel:
             coeff = coeff / batch.batch_size
         return oh.scatter_add(coeff)
 
+    def grad_regularized(
+        self,
+        w: jax.Array,
+        batch: SparseBatch,
+        y: jax.Array,
+        reduce: str = "sum",
+        blocked: bool = False,
+    ) -> jax.Array:
+        """Dense-in/dense-out worker gradient (backward reduce + regularize,
+        Slave.scala:142-157): one entry point for callers that hold dense
+        weights, routed through the blocked MXU kernels when `blocked`.
+        Engines that carry blocked weights across a scan call the blocked
+        methods directly instead."""
+        if blocked:
+            w2 = mxu.to_blocked(w, self.n_features)
+            g2 = self.grad_blocked(w2, batch, y, reduce=reduce)
+            return mxu.from_blocked(self.regularize_blocked(g2, w2), self.n_features)
+        g = self.grad_sum(w, batch, y) if reduce == "sum" else self.grad_mean(w, batch, y)
+        return self.regularize(g, w)
+
     def regularize_blocked(self, g2: jax.Array, w2: jax.Array) -> jax.Array:
         """`regularize` on the blocked view; zero pad lanes stay zero
         because the scalar is only added where g2 != 0."""

@@ -96,3 +96,15 @@ class TestEngineKernelEquivalence:
             outs[kernel] = (np.asarray(w_step), np.asarray(w_epoch))
         np.testing.assert_allclose(outs["mxu"][0], outs["scalar"][0], rtol=1e-4, atol=1e-6)
         np.testing.assert_allclose(outs["mxu"][1], outs["scalar"][1], rtol=1e-3, atol=1e-5)
+
+
+def test_grad_regularized_blocked_matches_scalar():
+    batch, y, d = _batch(seed=11)
+    model = _model(d)
+    w = jnp.asarray(np.random.default_rng(12).normal(size=d) * 0.1, dtype=jnp.float32)
+    for reduce in ("sum", "mean"):
+        got = model.grad_regularized(w, batch, y, reduce=reduce, blocked=True)
+        want = model.grad_regularized(w, batch, y, reduce=reduce, blocked=False)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-5
+        )
