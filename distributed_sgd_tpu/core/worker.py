@@ -89,7 +89,7 @@ class WorkerNode:
         self._async_lr = 0.0
 
         self._apply = jax.jit(lambda w, d: w - d)
-        self._grad_cache: Dict[Tuple[int, str], callable] = {}
+        self._grad_cache: Dict[int, callable] = {}  # keyed by padded capacity
 
         self.server = new_server(port, host="0.0.0.0")
         self.port = self.port or self.server.bound_port
@@ -182,7 +182,9 @@ class WorkerNode:
 
     def _blocked_device(self) -> bool:
         """Blocked MXU kernels pay off on this worker's pinned device?"""
-        return getattr(self.device, "platform", jax.default_backend()) == "tpu"
+        from distributed_sgd_tpu.ops import mxu
+
+        return mxu.blocked_pays_off(self.device)
 
     def _pad_ids(self, ids: np.ndarray) -> Tuple[jax.Array, jax.Array]:
         cap = _next_pow2(len(ids))

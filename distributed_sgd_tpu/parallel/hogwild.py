@@ -90,11 +90,18 @@ class _Worker:
         shard_n = len(shard)
         bs = batch_size
 
+        from distributed_sgd_tpu.ops import mxu
+
+        blocked = mxu.blocked_pays_off(device)
+
         def step(w, idx, val, y, key):
             ids = jax.random.randint(key, (bs,), 0, shard_n)
             batch = SparseBatch(idx[ids], val[ids])
-            g = model.grad_mean(w, batch, y[ids])  # MEAN (Slave.scala:93-98)
-            return learning_rate * model.regularize(g, w)  # Slave.scala:99
+            # MEAN (Slave.scala:93-98) + regularize (Slave.scala:99), on the
+            # blocked MXU path when this worker's device is a TPU
+            return learning_rate * model.grad_regularized(
+                w, batch, y[ids], reduce="mean", blocked=blocked
+            )
 
         self._step = jax.jit(step)
         self._apply = jax.jit(lambda w, d: w - d)

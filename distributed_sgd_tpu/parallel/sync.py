@@ -19,13 +19,15 @@ reference ships it over gRPC every batch, Master.scala:184-189).
 
 Three kernel backends (`kernel=`): 'mxu' (default) keeps weights in the
 lane-blocked [R, 128] view across the epoch scan and runs the sparse
-gather/scatter as one-hot MXU matmuls (ops/mxu.py, ~4x faster per step at
-RCV1 shapes); 'pallas' is the hand-fused single-launch version of the
-same formulation (ops/pallas_sparse.py — measured within ~30% of 'mxu' on
-v5e, kept as a first-class backend and the starting point for shapes
-where fusion wins); 'scalar' is the reference-shaped take/scatter path
-(ops/sparse.py).  All produce identical updates up to float summation
-order (tests/test_mxu_kernels.py, tests/test_pallas_kernels.py).
+gather/scatter as one-hot MXU matmuls (ops/mxu.py — ~32 us vs ~310 us per
+3-worker step at RCV1 shapes on v5e, benches/step_bench.py); 'pallas' is
+the hand-fused single-launch version of the same formulation
+(ops/pallas_sparse.py — ~109 us at the same config: beats scalar 3x but
+trails XLA's fusion of the big-matmul form; kept as a first-class backend
+and the starting point for shapes where manual fusion wins); 'scalar' is
+the reference-shaped take/scatter path (ops/sparse.py).  All produce
+identical updates up to float summation order (tests/test_mxu_kernels.py,
+tests/test_pallas_kernels.py).
 
 Batch sampling mirrors Master.scala:184 (`split.map(Random.shuffle(_))`
 then slice): every step each worker draws a fresh uniform batch from its
@@ -88,11 +90,13 @@ class BoundSync:
                 f"kernel must be 'mxu', 'scalar' or 'pallas', got {kernel!r}"
             )
         self.kernel = kernel
-        # the Pallas kernel needs the interpreter off-TPU (tests, CPU mesh),
-        # and the interpreter cannot type varying-mesh-axes (vma) through its
-        # grid emulation, so vma checking is disabled for that backend
+        # the Pallas kernel needs the interpreter off-TPU (tests, CPU mesh).
+        # vma (varying-mesh-axes) typing is disabled for the pallas backend
+        # everywhere: the interpreter cannot type vma through its grid
+        # emulation, and on TPU the vma-typed closed_call around pallas_call
+        # trips a lowering-cache KeyError inside jax (observed on jax 0.8)
         self._pallas_interpret = jax.default_backend() != "tpu"
-        self._check_vma = not (kernel == "pallas" and self._pallas_interpret)
+        self._check_vma = kernel != "pallas"
         self.model = model
         self.mesh = mesh
         self.data = data
