@@ -51,22 +51,22 @@ def main() -> None:
     _ = np.asarray(jnp.zeros(4))  # force synchronous dispatch (tunnel)
 
     print(f"{n} samples, {args.workers} workers x batch {B} "
-          f"({args.workers * B * P} entries/step); median-of-3, slope-fit")
+          f"({args.workers * B * P} entries/step); best-of-3, slope-fit")
     for kernel in args.kernels.split(","):
         eng = SyncEngine(model, mesh, batch_size=B, learning_rate=0.5,
                          kernel=kernel, virtual_workers=args.workers)
+        s1, s2 = 200, 1000
         ts = {}
-        for s1, s2 in ((200, 1000),):
-            for S in (s1, s2):
-                bound = eng.bind(data, steps_per_epoch=S)
-                np.asarray(bound.epoch(w0, key))  # compile + warm
-                best = float("inf")
-                for _ in range(3):
-                    t0 = time.perf_counter()
-                    np.asarray(bound.epoch(w0, key))
-                    best = min(best, time.perf_counter() - t0)
-                ts[S] = best
-            us = (ts[s2] - ts[s1]) / (s2 - s1) * 1e6
+        for S in (s1, s2):
+            bound = eng.bind(data, steps_per_epoch=S)
+            np.asarray(bound.epoch(w0, key))  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(bound.epoch(w0, key))
+                best = min(best, time.perf_counter() - t0)
+            ts[S] = best
+        us = (ts[s2] - ts[s1]) / (s2 - s1) * 1e6
         print(f"  kernel={kernel:>7}: {us:8.2f} us/step")
 
 

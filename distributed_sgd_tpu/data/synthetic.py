@@ -29,6 +29,11 @@ def rcv1_like(
     idx = rng.choice(n_features, size=(n_samples, nnz), p=pop).astype(np.int32)
     idx.sort(axis=1)
     val = np.abs(rng.normal(size=(n_samples, nnz))).astype(np.float32)
+    # real RCV1 rows (and the reference's Map-backed vectors) cannot hold
+    # duplicate feature ids: zero out repeat draws, leaving inert pad slots
+    dup = np.zeros_like(idx, dtype=bool)
+    dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    val[dup] = 0.0
     val /= np.maximum(np.linalg.norm(val, axis=1, keepdims=True), 1e-12)  # cosine norm
 
     w_true = rng.normal(size=n_features).astype(np.float32)
