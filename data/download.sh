@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Fetch RCV1 (reference data/download.sh:1-11 equivalent): the LYRL2004
+# token vector files + topic assignments from the public mirrors.  Run
+# from the repo root; files land in ./data/ where load_rcv1 expects them
+# (data/rcv1.py).  In no-egress environments use DSGD_SYNTHETIC instead
+# (data/synthetic.py generates RCV1-shaped data).
+set -euo pipefail
+cd "$(dirname "$0")"
+
+BASE="http://www.ai.mit.edu/projects/jmlr/papers/volume5/lewis04a"
+
+for f in \
+  a12-token-files/lyrl2004_tokens_train.dat \
+  a13-vector-files/lyrl2004_vectors_train.dat \
+  a13-vector-files/lyrl2004_vectors_test_pt0.dat \
+  a13-vector-files/lyrl2004_vectors_test_pt1.dat \
+  a13-vector-files/lyrl2004_vectors_test_pt2.dat \
+  a13-vector-files/lyrl2004_vectors_test_pt3.dat \
+  a08-topic-qrels/rcv1-v2.topics.qrels
+do
+  name=$(basename "$f")
+  if [ ! -f "$name" ]; then
+    curl -fL "$BASE/$f.gz" -o "$name.gz"
+    gunzip -f "$name.gz"
+  fi
+done
+echo "RCV1 ready: $(ls -1 *.dat *.qrels 2>/dev/null | wc -l) files"
