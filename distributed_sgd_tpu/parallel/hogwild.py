@@ -144,14 +144,21 @@ class _Worker:
 
     # -- hot loop (Slave.asyncTask, Slave.scala:79-111) --------------------
     def _drain_inbox(self) -> None:
+        # deltas commute (w <- w - d, Slave.scala:177-185), so the queued
+        # batch sums on host and applies in ONE device dispatch
+        acc = None
+        n = 0
         while True:
             try:
                 d = self.inbox.get_nowait()
             except queue.Empty:
-                return
+                break
+            acc = d if acc is None else acc + d
+            n += 1
+        if acc is not None:
             with self._lock:
-                self.w = self._apply(self.w, jnp.asarray(d))
-            self.metrics.counter("slave.async.grad.update").increment()
+                self.w = self._apply(self.w, jnp.asarray(acc))
+            self.metrics.counter("slave.async.grad.update").increment(n)
 
     def _loop(self) -> None:
         while self._running.is_set():
