@@ -1,0 +1,105 @@
+"""Real 2-process jax.distributed validation of parallel/multihost.py.
+
+Spawns two CPU-backend processes that initialize jax.distributed against a
+localhost coordinator, build the GLOBAL mesh (4 devices = 2 hosts x 2 local
+CPU devices), each load only their host's shard rows (host_shard_bounds),
+and run one SyncEngine training step + eval.  Asserts both processes
+produce identical weights — the real multi-host sync-DP code path, not a
+simulation (SURVEY.md §5.8; kube/dsgd.yaml topology equivalent).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+_CHILD = r"""
+import os, sys
+import numpy as np
+
+pid = int(sys.argv[1]); port = sys.argv[2]; out = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ.pop("JAX_COORDINATOR_ADDRESS", None)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from distributed_sgd_tpu.parallel import multihost
+
+multihost.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()
+
+import jax.numpy as jnp
+from distributed_sgd_tpu.data.rcv1 import Dataset
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import SparseSVM
+from distributed_sgd_tpu.parallel.sync import SyncEngine, padded_layout
+
+D, N = 200, 64
+full = rcv1_like(N, n_features=D, nnz=6, seed=0)  # deterministic everywhere
+mesh = multihost.global_mesh()
+
+# host-local loading: materialise ONLY this host's padded row range
+start, end = multihost.host_shard_bounds(N, eval_chunk=8)
+total, _ = padded_layout(N, 4, eval_chunk=8)
+idx = np.zeros((total, full.pad_width), np.int32)
+val = np.zeros((total, full.pad_width), np.float32)
+lab = np.zeros((total,), np.int32)
+idx[:N], val[:N], lab[:N] = full.indices, full.values, full.labels
+local = Dataset(idx[start:end], val[start:end], lab[start:end], D)
+
+# global arrays from per-host shards (jax.make_array_from_process_local_data)
+from jax.sharding import NamedSharding, PartitionSpec as P
+sharding = NamedSharding(mesh, P("workers"))
+gidx = jax.make_array_from_process_local_data(sharding, local.indices, (total, full.pad_width))
+gval = jax.make_array_from_process_local_data(sharding, local.values, (total, full.pad_width))
+glab = jax.make_array_from_process_local_data(sharding, local.labels, (total,))
+
+from distributed_sgd_tpu.parallel.sync import BoundSync, ShardedData
+model = SparseSVM(lam=1e-3, n_features=D,
+                  dim_sparsity=jnp.asarray(np.full(D, 0.01, np.float32)))
+bound = BoundSync(model, mesh, ShardedData(gidx, gval, glab, N),
+                  batch_size=4, learning_rate=0.3, eval_chunk=8)
+
+w = jnp.zeros(D, dtype=jnp.float32)
+key = jax.random.PRNGKey(5)
+w = bound.step(w, key)
+w = bound.epoch(w, key)
+loss, acc = bound.evaluate(w)
+np.save(out, np.asarray(jax.device_get(w)))
+print(f"proc {pid}: loss={loss:.6f} acc={acc:.4f}", flush=True)
+"""
+
+
+def test_two_process_global_mesh_sync(tmp_path):
+    port = 12355 + os.getpid() % 1000
+    outs = [str(tmp_path / f"w{i}.npy") for i in range(2)]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(i), str(port), outs[i]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    logs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=200)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        logs.append(out)
+    for p, out in zip(procs, logs):
+        assert p.returncode == 0, f"child failed:\n{out}"
+    w0, w1 = np.load(outs[0]), np.load(outs[1])
+    np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
+    assert np.any(w0 != 0.0)
