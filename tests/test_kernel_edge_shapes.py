@@ -1,0 +1,80 @@
+"""Edge-shape sweep for the blocked kernel layouts.
+
+Lane-blocked layouts classically break at boundary shapes: feature dims
+below one lane (D < 128), exactly on a block edge (D = 128k), one-past
+(D = 128k + 1), single-sample and single-nnz batches.  Every (layout,
+shape) pair must agree with the scalar-path kernels.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.models.linear import SparseSVM
+from distributed_sgd_tpu.ops import flat_sparse, mxu, pallas_sparse
+from distributed_sgd_tpu.ops.sparse import SparseBatch, matvec, scatter_add
+
+DIMS = [1, 5, 127, 128, 129, 1024, 1025]
+BATCHES = [(1, 1), (1, 4), (3, 1), (9, 5)]
+
+
+def _mk(b, p, d, seed):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, d, (b, p)).astype(np.int32)
+    val = rng.normal(size=(b, p)).astype(np.float32)
+    if b * p > 2:
+        val.reshape(-1)[rng.integers(0, b * p, 2)] = 0.0  # some pads
+    y = rng.choice([-1, 1], b).astype(np.int32)
+    return SparseBatch(jnp.asarray(idx), jnp.asarray(val)), jnp.asarray(y)
+
+
+@pytest.mark.parametrize("d", DIMS)
+@pytest.mark.parametrize("bp", BATCHES)
+def test_mxu_kernels_all_shapes(d, bp):
+    b, p = bp
+    batch, _ = _mk(b, p, d, seed=d * 31 + b)
+    w = jnp.asarray(np.random.default_rng(d).normal(size=d), dtype=jnp.float32)
+    w2 = mxu.to_blocked(w, d)
+    np.testing.assert_allclose(
+        np.asarray(mxu.matvec(batch, w2)),
+        np.asarray(matvec(batch, w)),
+        rtol=1e-4, atol=1e-5,
+    )
+    coeff = jnp.asarray(np.random.default_rng(d + 1).normal(size=b), dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(mxu.from_blocked(mxu.scatter_add(batch, coeff, mxu.n_blocks(d)), d)),
+        np.asarray(scatter_add(batch, coeff, d)),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("d", [1, 127, 129, 1025])
+@pytest.mark.parametrize("bp", BATCHES)
+def test_pallas_kernel_all_shapes(d, bp):
+    b, p = bp
+    batch, y = _mk(b, p, d, seed=d * 17 + b)
+    model = SparseSVM(lam=1e-3, n_features=d,
+                      dim_sparsity=jnp.asarray(np.full(d, 0.01, np.float32)))
+    w2 = mxu.to_blocked(
+        jnp.asarray(np.random.default_rng(d).normal(size=d), dtype=jnp.float32), d
+    )
+    got = pallas_sparse.worker_grads(
+        w2, batch.indices[None], batch.values[None], y[None],
+        model.grad_coeff, interpret=True,
+    )
+    want = model.grad_blocked(w2, batch, y)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want), rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("d", [1, 128, 129])
+def test_flat_sparse_all_shapes(d):
+    batch, _ = _mk(4, 3, d, seed=d)
+    flat = flat_sparse.from_padded(
+        SparseBatch(np.asarray(batch.indices), np.asarray(batch.values))
+    )
+    w = jnp.asarray(np.random.default_rng(d).normal(size=d), dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(flat_sparse.matvec(flat, w)),
+        np.asarray(matvec(batch, w)),
+        rtol=1e-4, atol=1e-5,
+    )
