@@ -227,6 +227,12 @@ class MasterNode:
         return out
 
     def distributed_loss(self, weights: np.ndarray) -> float:
+        """Objective from the Forward fan-out (Master.scala:77-98).
+
+        Reconstructs per-sample loss from PREDICTIONS, like the reference —
+        exact for prediction-based losses (the reference's hinge); use the
+        mesh engines' evaluate() for margin-based losses (logistic etc.).
+        """
         preds = self.predict(weights)
         y = self.train.labels
         sample = np.asarray(

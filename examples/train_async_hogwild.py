@@ -1,0 +1,38 @@
+"""Asynchronous Hogwild training: 4 gossiping workers, leaky-smoothed loss
+checking, best-weights return — the reference's async mode
+(Slave.scala:79-111 / MasterAsync.scala), host-driven.
+
+    python examples/train_async_hogwild.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_sgd_tpu.core.early_stopping import no_improvement  # noqa: E402
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split  # noqa: E402
+from distributed_sgd_tpu.data.synthetic import rcv1_like  # noqa: E402
+from distributed_sgd_tpu.models.linear import make_model  # noqa: E402
+from distributed_sgd_tpu.parallel.hogwild import HogwildEngine  # noqa: E402
+
+
+def main(n: int = 3_000) -> float:
+    data = rcv1_like(n, seed=0)
+    train, test = train_test_split(data)
+    model = make_model(
+        "hinge", 1e-5, data.n_features, dim_sparsity=jnp.asarray(dim_sparsity(train))
+    )
+    eng = HogwildEngine(
+        model, n_workers=4, batch_size=100, learning_rate=0.5, check_every=100
+    )
+    res = eng.fit(train, test, max_epochs=1,
+                  criterion=no_improvement(patience=5, min_delta=0.001))
+    print(f"updates={res.state.updates} best_test_loss={res.state.loss:.4f}")
+    return res.state.loss
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Minimal single-chip training run: synthetic RCV1-shaped data, hinge SVM,
+compiled sync epochs, early stopping.
+
+    python examples/train_single_chip.py [n_samples]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+
+from distributed_sgd_tpu.core.early_stopping import no_improvement  # noqa: E402
+from distributed_sgd_tpu.core.trainer import SyncTrainer  # noqa: E402
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split  # noqa: E402
+from distributed_sgd_tpu.data.synthetic import rcv1_like  # noqa: E402
+from distributed_sgd_tpu.models.linear import make_model  # noqa: E402
+from distributed_sgd_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+
+def main(n: int = 20_000, max_epochs: int = 5) -> float:
+    data = rcv1_like(n, seed=0)
+    train, test = train_test_split(data)
+    model = make_model(
+        "hinge", 1e-5, data.n_features, dim_sparsity=jnp.asarray(dim_sparsity(train))
+    )
+    trainer = SyncTrainer(
+        model,
+        make_mesh(1),
+        batch_size=100,
+        learning_rate=0.5,
+        virtual_workers=3,  # the reference's default nodeCount, on one chip
+    )
+    res = trainer.fit(
+        train, test, max_epochs, criterion=no_improvement(patience=3, min_delta=0.01)
+    )
+    print(f"epochs={res.epochs_run} test_loss={res.test_losses[-1]:.4f} "
+          f"test_acc={res.test_accuracies[-1]:.4f}")
+    return res.test_losses[-1]
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 20_000)

@@ -1,0 +1,25 @@
+"""Examples must stay runnable: execute each main() at tiny scale on the
+CPU test mesh and check it converges to a finite loss."""
+
+import numpy as np
+
+
+def test_single_chip_example():
+    from examples.train_single_chip import main
+
+    loss = main(n=800, max_epochs=2)
+    assert np.isfinite(loss)
+
+
+def test_custom_model_example():
+    from examples.custom_model import main
+
+    loss = main(n=600)
+    assert np.isfinite(loss)
+
+
+def test_async_hogwild_example():
+    from examples.train_async_hogwild import main
+
+    loss = main(n=600)
+    assert np.isfinite(loss)
