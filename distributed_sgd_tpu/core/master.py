@@ -50,6 +50,48 @@ from distributed_sgd_tpu.utils.log import node_logger
 SplitFn = Callable[[int, int], List[np.ndarray]]
 
 
+class _FailureTracker:
+    """Consecutive-failure counter with an eviction threshold.
+
+    Shared policy for every fan-out that classifies worker failures
+    (heartbeat probes, Gradient barriers, Forward eval): a success resets
+    the worker's count; `record_failure` returns True once the worker has
+    failed `threshold` consecutive times and should be declared dead.
+    """
+
+    def __init__(self, threshold: int):
+        self.threshold = max(1, int(threshold))
+        self._counts: Dict[Tuple[str, int], int] = {}
+
+    def record_ok(self, key: Tuple[str, int]) -> None:
+        self._counts.pop(key, None)
+
+    def record_failure(self, key: Tuple[str, int]) -> Tuple[int, bool]:
+        n = self._counts.get(key, 0) + 1
+        if n >= self.threshold:
+            self._counts.pop(key, None)
+            return n, True
+        self._counts[key] = n
+        return n, False
+
+
+def _await_futures(futs):
+    """Barrier with failure classification over [(key, future-or-None)].
+
+    Returns (ok, failed): ok = [(key, reply)] in input order, failed =
+    [(key, status-or-error)].  A None future stands for a channel that
+    closed under us at call time."""
+    ok, failed = [], []
+    for key, fut in futs:
+        try:
+            if fut is None:
+                raise ValueError("channel closed")
+            ok.append((key, fut.result()))
+        except (grpc.RpcError, ValueError) as e:
+            failed.append((key, e.code() if isinstance(e, grpc.RpcError) else e))
+    return ok, failed
+
+
 class MasterNode:
     def __init__(
         self,
@@ -115,10 +157,9 @@ class MasterNode:
         return self
 
     def _heartbeat_loop(self, interval_s: float, max_failures: int = 3) -> None:
-        failures: Dict[Tuple[str, int], int] = {}
+        tracker = _FailureTracker(max_failures)
         while not self._hb_stop.wait(interval_s):
-            with self._members_lock:
-                members = list(self._workers.items())
+            members = self._members()
             # probe concurrently so one dead worker costs one timeout, not D
             futs = []
             for key, stub in members:
@@ -126,23 +167,18 @@ class MasterNode:
                     futs.append((key, stub.Ping.future(pb.Empty(), timeout=interval_s)))
                 except ValueError:  # channel closed under us (unregister/stop)
                     futs.append((key, None))
-            for key, fut in futs:
-                try:
-                    if fut is not None:
-                        fut.result()
-                        failures.pop(key, None)
-                        continue
-                except (grpc.RpcError, ValueError):
-                    pass
+            ok, failed = _await_futures(futs)
+            for key, _ in ok:
+                tracker.record_ok(key)
+            for key, _ in failed:
                 with self._members_lock:
                     still_member = key in self._workers
                 if still_member:
-                    failures[key] = failures.get(key, 0) + 1
+                    n, evict = tracker.record_failure(key)
                     self.log.warning("heartbeat miss %d/%d for %s:%d",
-                                     failures[key], max_failures, *key)
-                    if failures[key] >= max_failures:
+                                     n, max_failures, *key)
+                    if evict:
                         self.log.warning("worker %s:%d declared dead", *key)
-                        failures.pop(key, None)
                         self.unregister_worker(*key)
 
     def stop(self) -> None:
@@ -204,27 +240,64 @@ class MasterNode:
                 pass
         self.log.info("worker unregistered: %s:%d", host, port)
 
-    def _stubs(self) -> List[WorkerStub]:
+    def _members(self) -> List[Tuple[Tuple[str, int], WorkerStub]]:
         with self._members_lock:
-            return [self._workers[k] for k in self._order]
+            return [(k, self._workers[k]) for k in self._order]
+
+    def _stubs(self) -> List[WorkerStub]:
+        return [stub for _, stub in self._members()]
 
     # -- distributed eval (Master.scala:61-98) -----------------------------
 
-    def predict(self, weights: np.ndarray, split: SplitFn = vanilla_split) -> np.ndarray:
-        """Fan ForwardRequests out to every worker; gather predictions."""
+    def predict(
+        self,
+        weights: np.ndarray,
+        split: SplitFn = vanilla_split,
+        timeout_s: float = 60.0,
+        retries: int = 1,
+    ) -> np.ndarray:
+        """Fan ForwardRequests out to every worker; gather predictions.
+
+        Same fault policy as fit_sync: per-call deadlines, `retries`
+        consecutive failures evict the worker, and the fan-out is retried
+        across the survivors with a fresh split.  Raises RuntimeError if
+        every worker is lost.
+        """
         self._require_ready()
-        stubs = self._stubs()
-        parts = split(len(self.train), len(stubs))
         wmsg = codec.encode_tensor(weights)
-        futs = [
-            stub.Forward.future(pb.ForwardRequest(samples=ids.astype(np.int32), weights=wmsg))
-            for stub, ids in zip(stubs, parts)
-        ]
-        out = np.zeros(len(self.train), dtype=np.float32)
-        for ids, fut in zip(parts, futs):
-            reply = fut.result()
-            out[ids] = np.fromiter(reply.predictions, dtype=np.float32)
-        return out
+        tracker = _FailureTracker(retries + 1)
+        while True:
+            members = self._members()
+            if not members:
+                raise RuntimeError("all workers lost during predict")
+            parts = split(len(self.train), len(members))
+            futs = []
+            for (key, stub), ids in zip(members, parts):
+                try:
+                    fut = stub.Forward.future(
+                        pb.ForwardRequest(samples=ids.astype(np.int32), weights=wmsg),
+                        timeout=timeout_s,
+                    )
+                except ValueError:
+                    fut = None
+                futs.append((key, fut))
+            ok, failed = _await_futures(futs)
+            if not failed:
+                out = np.zeros(len(self.train), dtype=np.float32)
+                for ids, (_, reply) in zip(parts, ok):
+                    out[ids] = np.fromiter(reply.predictions, dtype=np.float32)
+                return out
+            for key, _ in ok:
+                tracker.record_ok(key)
+            for key, code in failed:
+                n, evict = tracker.record_failure(key)
+                if evict:
+                    self.log.warning("worker %s:%d failed Forward %d times (%s); "
+                                     "declaring dead", key[0], key[1], n, code)
+                    self.unregister_worker(*key)
+                else:
+                    self.log.warning("worker %s:%d failed Forward (%s); retry %d/%d",
+                                     key[0], key[1], code, n, retries)
 
     def distributed_loss(self, weights: np.ndarray) -> float:
         """Objective from the Forward fan-out (Master.scala:77-98).
@@ -259,10 +332,29 @@ class MasterNode:
         criterion: Optional[Criterion] = None,
         split: SplitFn = vanilla_split,
         initial_weights: Optional[np.ndarray] = None,
+        grad_timeout_s: float = 30.0,
+        on_worker_death: str = "resplit",
+        grad_retries: int = 1,
     ) -> FitResult:
+        """Fault-tolerant sync fit.
+
+        The reference's barrier (`Future.sequence`, Master.scala:190) hangs
+        forever if a worker dies mid-fit.  Here every Gradient call carries a
+        deadline (`grad_timeout_s`), membership is re-read every batch, and a
+        worker whose call fails `grad_retries + 1` consecutive times (grace
+        for transient blips / first-call compile latency; a success resets
+        the count) is declared dead.  What happens then is the caller's
+        choice: `on_worker_death="resplit"` (default) unregisters it and
+        retries the batch across the survivors with a fresh re-split;
+        `on_worker_death="fail"` raises WITHOUT touching membership, so the
+        caller can investigate the intact cluster.
+        """
+        if on_worker_death not in ("resplit", "fail"):
+            raise ValueError(f"on_worker_death must be resplit|fail, got {on_worker_death!r}")
         self._require_ready()
-        stubs = self._stubs()
-        parts = split(len(self.train), len(stubs))
+        members = self._members()
+        keys = [k for k, _ in members]
+        parts = split(len(self.train), len(members))
         max_samples = max(len(p) for p in parts)
         w = (
             np.zeros(self.model.n_features, dtype=np.float32)
@@ -271,24 +363,67 @@ class MasterNode:
         )
         result = FitResult(state=GradState(weights=w))
         test_newest_first: List[float] = []
+        tracker = _FailureTracker(grad_retries + 1)
 
         for epoch in range(max_epochs):
             t0 = time.perf_counter()
-            for batch in range(0, max_samples, batch_size):
-                with self.metrics.timer("master.sync.batch.duration"):
-                    wmsg = codec.encode_tensor(w)
-                    futs = []
-                    for stub, part in zip(stubs, parts):
-                        shuffled = self._rng.permutation(part)  # Master.scala:184
-                        ids = shuffled[batch : batch + batch_size]
-                        futs.append(
-                            stub.Gradient.future(
-                                pb.GradientRequest(weights=wmsg, samples=ids.astype(np.int32))
-                            )
+            batch = 0
+            while batch < max_samples:
+                # live membership: heartbeat-driven unregister_worker (or a
+                # graceful leave) reaches the loop here, not at fit start
+                current = self._members()
+                if [k for k, _ in current] != keys:
+                    if not current:
+                        raise RuntimeError("all workers lost mid-fit")
+                    members, keys = current, [k for k, _ in current]
+                    parts = split(len(self.train), len(members))
+                    max_samples = max(len(p) for p in parts)
+                    self.log.warning("membership changed; re-split across %d workers",
+                                     len(members))
+                    if batch >= max_samples:
+                        break
+                t_batch = time.perf_counter()
+                wmsg = codec.encode_tensor(w)
+                futs = []
+                for (key, stub), part in zip(members, parts):
+                    shuffled = self._rng.permutation(part)  # Master.scala:184
+                    ids = shuffled[batch : batch + batch_size]
+                    try:
+                        fut = stub.Gradient.future(
+                            pb.GradientRequest(weights=wmsg, samples=ids.astype(np.int32)),
+                            timeout=grad_timeout_s,
                         )
-                    grads = [codec.decode_grad(f.result()) for f in futs]  # barrier
-                    grad = np.mean(grads, axis=0)  # Vec.mean (Master.scala:194)
-                    w = w - learning_rate * grad
+                    except ValueError:  # channel closed under us
+                        fut = None
+                    futs.append((key, fut))
+                ok, failed = _await_futures(futs)  # barrier, with deadlines
+                if failed:
+                    for key, _ in ok:
+                        tracker.record_ok(key)
+                    for key, code in failed:
+                        n, evict = tracker.record_failure(key)
+                        if not evict:
+                            self.log.warning(
+                                "worker %s:%d failed Gradient (%s); retry %d/%d",
+                                key[0], key[1], code, n, grad_retries)
+                            continue
+                        if on_worker_death == "fail":
+                            # abort WITHOUT mutating membership: the caller
+                            # chose to investigate, not to continue degraded
+                            raise RuntimeError(
+                                f"worker {key[0]}:{key[1]} died mid-fit "
+                                f"({n} consecutive Gradient failures: {code})")
+                        self.log.warning(
+                            "worker %s:%d failed Gradient %d times (%s); declaring dead",
+                            key[0], key[1], n, code)
+                        self.unregister_worker(*key)
+                    continue  # retry this batch window (survivors or re-split)
+                grads = [codec.decode_grad(reply) for _, reply in ok]
+                grad = np.mean(grads, axis=0)  # Vec.mean (Master.scala:194)
+                w = w - learning_rate * grad
+                self.metrics.histogram("master.sync.batch.duration").record(
+                    time.perf_counter() - t_batch)
+                batch += batch_size
             epoch_s = time.perf_counter() - t0
 
             loss, acc = self.local_loss(w)

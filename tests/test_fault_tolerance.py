@@ -1,0 +1,138 @@
+"""Fault-injection tests: kill a worker mid-sync-fit.
+
+The reference hangs forever in this scenario (`Future.sequence` barrier
+with no deadline, Master.scala:190).  Our fit_sync carries per-call
+deadlines, re-reads membership every batch, and re-splits across the
+survivors (or fails fast, by choice)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.data.rcv1 import train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import LogisticRegression
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=31))
+
+
+def _model():
+    return LogisticRegression(lam=1e-5, n_features=128, regularizer="l2")
+
+
+def _hard_kill(worker):
+    """Simulate a crash: tear the gRPC server down with no unregister."""
+    worker._stopped.set()
+    worker.server.stop(grace=0)
+
+
+def _run_fit_with_midfit_kill(cluster, **fit_kwargs):
+    """Start fit_sync in a thread; hard-kill worker 0 the moment it has
+    served its first Gradient call.  Returns (result_or_exception, joined)."""
+    gone = cluster.workers[0]
+    first_call = threading.Event()
+    orig = gone.compute_gradient
+
+    def traced(w, ids):
+        first_call.set()
+        return orig(w, ids)
+
+    gone.compute_gradient = traced
+
+    box = {}
+
+    def run():
+        try:
+            box["result"] = cluster.master.fit_sync(**fit_kwargs)
+        except Exception as e:  # noqa: BLE001 - surfaced to the test
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert first_call.wait(30), "fit never reached a worker"
+    _hard_kill(gone)
+    t.join(timeout=120)
+    return box, not t.is_alive()
+
+
+def test_sync_fit_survives_worker_death(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=3) as c:
+        box, joined = _run_fit_with_midfit_kill(
+            c, max_epochs=4, batch_size=16, learning_rate=0.5, grad_timeout_s=5.0
+        )
+        assert joined, "fit_sync hung after worker death (the reference flaw)"
+        assert "error" not in box, f"fit raised: {box.get('error')}"
+        res = box["result"]
+        assert res.epochs_run == 4
+        assert res.losses[-1] < res.losses[0]
+        # the dead worker was evicted from membership
+        assert len(c.master._workers) == 2
+
+
+def test_sync_fit_fail_fast_mode(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        box, joined = _run_fit_with_midfit_kill(
+            c, max_epochs=4, batch_size=16, learning_rate=0.5,
+            grad_timeout_s=5.0, on_worker_death="fail",
+        )
+        assert joined
+        assert isinstance(box.get("error"), RuntimeError)
+        # fail mode must NOT mutate membership: the caller chose to abort
+        # and investigate, not to continue degraded
+        assert len(c.master._workers) == 2
+
+
+def test_sync_fit_all_workers_lost(data):
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=1) as c:
+        box, joined = _run_fit_with_midfit_kill(
+            c, max_epochs=4, batch_size=16, learning_rate=0.5, grad_timeout_s=5.0
+        )
+        assert joined
+        assert isinstance(box.get("error"), RuntimeError)
+        assert "all workers lost" in str(box["error"])
+
+
+def test_predict_survives_worker_death(data):
+    """The eval fan-out (Forward) carries the same deadline/evict/re-split
+    policy as fit_sync instead of the reference's hang-forever barrier."""
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=3) as c:
+        w = np.zeros(128, dtype=np.float32)
+        _hard_kill(c.workers[0])
+        preds = c.master.predict(w, timeout_s=5.0)
+        assert preds.shape == (len(train),)
+        assert len(c.master._workers) == 2
+        # and with no survivors it raises instead of hanging
+        for wk in c.workers[1:]:
+            _hard_kill(wk)
+        with pytest.raises(RuntimeError, match="all workers lost"):
+            c.master.predict(w, timeout_s=2.0)
+
+
+def test_heartbeat_eviction_then_fit(data):
+    """A worker that dies while the cluster is idle is evicted by the
+    heartbeat, and a subsequent fit runs on the surviving membership.
+    (The mid-fit membership-change/re-split branch itself is exercised by
+    test_sync_fit_survives_worker_death via the gradient-failure path.)"""
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=3, heartbeat_s=0.2) as c:
+        gone = c.workers[0]
+        _hard_kill(gone)
+        deadline = time.time() + 15
+        while time.time() < deadline and len(c.master._workers) > 2:
+            time.sleep(0.05)
+        assert len(c.master._workers) == 2, "heartbeat never evicted dead worker"
+        res = c.master.fit_sync(
+            max_epochs=2, batch_size=16, learning_rate=0.5, grad_timeout_s=5.0
+        )
+        assert res.epochs_run == 2
+        assert np.isfinite(res.losses[-1])
