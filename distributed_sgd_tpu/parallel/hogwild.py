@@ -9,11 +9,15 @@ threads), while each worker's compute step is a compiled device function:
 - worker i owns a weights replica on its own device and a resident shard
   of the training data (vanilla contiguous assignment, as sent in
   StartAsyncRequest, MasterAsync.scala:52-55);
-- its hot loop draws a uniform batch from the shard, computes
-  ``delta = lr * regularize(mean of backwards)`` ON DEVICE
-  (Slave.scala:93-99 — note MEAN here vs the sync mode's SUM), applies it
-  locally, and gossips the delta to every peer and the master,
-  fire-and-forget (Slave.scala:103-105);
+- its hot loop runs `steps_per_dispatch` (k) local SGD steps in ONE
+  compiled program — each step draws a uniform batch from the shard and
+  computes ``delta = lr * regularize(mean of backwards)`` ON DEVICE
+  (Slave.scala:93-99 — note MEAN here vs the sync mode's SUM) against the
+  locally-updated weights — then gossips the SUMMED delta to every peer
+  and the master, fire-and-forget (Slave.scala:103-105).  k=1 is the
+  reference's per-step gossip; larger k amortizes host dispatch (the
+  bottleneck on slow transports) at the cost of gossip staleness bounded
+  by k local steps;
 - all weight mutations are *delta subtractions* — commutative — so a
   stale-snapshot step composes with concurrent incoming deltas exactly
   like the reference's STM `transform(_ - delta)` (Slave.scala:101,180);
@@ -73,10 +77,12 @@ class _Worker:
         seed: int,
         metrics: metrics_mod.Metrics,
         max_inbox: int = 1024,
+        steps_per_dispatch: int = 1,
     ):
         self.wid = wid
         self.device = device
         self.metrics = metrics
+        self.k = max(1, int(steps_per_dispatch))
         self.inbox: "queue.Queue[np.ndarray]" = queue.Queue(maxsize=max_inbox)
         self._lock = threading.Lock()
         self._running = threading.Event()
@@ -94,16 +100,46 @@ class _Worker:
 
         blocked = mxu.blocked_pays_off(device)
 
-        def step(w, idx, val, y, key):
-            ids = jax.random.randint(key, (bs,), 0, shard_n)
-            batch = SparseBatch(idx[ids], val[ids])
-            # MEAN (Slave.scala:93-98) + regularize (Slave.scala:99), on the
-            # blocked MXU path when this worker's device is a TPU
-            return learning_rate * model.grad_regularized(
-                w, batch, y[ids], reduce="mean", blocked=blocked
-            )
+        k = self.k
 
-        self._step = jax.jit(step)
+        n_features = model.n_features
+
+        def kstep(w, idx, val, y, key):
+            # k local SGD steps in ONE compiled dispatch (lax.scan), each on
+            # the locally-updated weights; returns the SUMMED delta for
+            # gossip.  Deltas commute (every mutation is a subtraction,
+            # Slave.scala:101,180), so peers merging the sum see exactly the
+            # k individual merges; what changes vs k=1 is only *when* they
+            # see them — a bounded staleness period of k local steps, the
+            # dispatch-amortization knob for slow transports.  On the MXU
+            # path weights stay in the blocked layout ACROSS the scan —
+            # one to/from conversion per dispatch, not per step (the
+            # pattern of local_sgd.round_shard).
+            if blocked:
+                from distributed_sgd_tpu.ops import mxu as _mxu
+
+                w = _mxu.to_blocked(w, n_features)
+
+            def body(carry, kk):
+                w_t, acc = carry
+                ids = jax.random.randint(kk, (bs,), 0, shard_n)
+                batch = SparseBatch(idx[ids], val[ids])
+                # MEAN (Slave.scala:93-98) + regularize (Slave.scala:99)
+                if blocked:
+                    g = model.grad_blocked(w_t, batch, y[ids], reduce="mean")
+                    delta = learning_rate * model.regularize_blocked(g, w_t)
+                else:
+                    g = model.grad_mean(w_t, batch, y[ids])
+                    delta = learning_rate * model.regularize(g, w_t)
+                return (w_t - delta, acc + delta), None
+
+            keys = jax.random.split(key, k)
+            (_, acc), _ = jax.lax.scan(body, (w, jnp.zeros_like(w)), keys)
+            if blocked:
+                acc = _mxu.from_blocked(acc, n_features)
+            return acc
+
+        self._step = jax.jit(kstep)
         self._apply = jax.jit(lambda w, d: w - d)
         self.w: Optional[jax.Array] = None
         self._peers: List["_Worker"] = []
@@ -168,13 +204,13 @@ class _Worker:
             delta = self._step(snapshot, self._idx, self._val, self._y, k)
             with self._lock:
                 self.w = self._apply(self.w, delta)
-            self.metrics.counter("slave.async.batch").increment()
+            self.metrics.counter("slave.async.batch").increment(self.k)
             delta_np = np.asarray(delta)  # host hop = the wire serialization
             for peer in self._peers:
                 peer.push_delta(delta_np)
             if self._master is not None:
-                self._master._update_grad(delta_np)
-            self._t += 1
+                self._master._update_grad(delta_np, n_steps=self.k)
+            self._t += self.k
 
 
 class HogwildEngine:
@@ -192,9 +228,18 @@ class HogwildEngine:
         devices=None,
         seed: int = 0,
         metrics: Optional[metrics_mod.Metrics] = None,
+        steps_per_dispatch: int = 1,
     ):
+        """steps_per_dispatch=k amortizes host dispatch: each worker runs k
+        local SGD steps in one compiled program and gossips the summed
+        delta every k steps.  k=1 is the reference's per-step gossip
+        (Slave.scala:103-105); larger k trades gossip freshness (staleness
+        bounded by k local steps) for k× fewer host hops — the difference
+        that matters on slow transports like the tunnel."""
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
+        if steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
         self.model = model
         self.n_workers = n_workers
         self.batch_size = batch_size
@@ -202,6 +247,7 @@ class HogwildEngine:
         self.check_every = check_every
         self.leaky_loss = leaky_loss
         self.backoff_s = backoff_s
+        self.steps_per_dispatch = int(steps_per_dispatch)
         self.seed = seed
         self.metrics = metrics or metrics_mod.global_metrics()
         devs = list(devices if devices is not None else jax.devices())
@@ -215,11 +261,12 @@ class HogwildEngine:
         self._stop = threading.Event()
         self._max_steps = 0
 
-    # master updateGrad RPC (MasterAsync.scala:164-177)
-    def _update_grad(self, delta: np.ndarray) -> None:
+    # master updateGrad RPC (MasterAsync.scala:164-177); one gossip message
+    # carries n_steps local steps, and maxSteps counts local steps
+    def _update_grad(self, delta: np.ndarray, n_steps: int = 1) -> None:
         with self._lock:
             self._w_master = self._apply(self._w_master, jnp.asarray(delta))
-            self._updates += 1
+            self._updates += n_steps
             updates = self._updates
         if updates % 1000 == 0:
             log.info("%d updates received", updates)
@@ -257,6 +304,7 @@ class HogwildEngine:
                 self.learning_rate,
                 self.seed,
                 self.metrics,
+                steps_per_dispatch=self.steps_per_dispatch,
             )
             for i in range(self.n_workers)
         ]
