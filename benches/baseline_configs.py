@@ -153,16 +153,16 @@ def config4(scale):
 
 
 def config5(scale):
-    """sync dense least-squares, synthetic 1M x 1024 (dense rows)."""
+    """sync dense least-squares, synthetic 1M x 1024 (dense layout: plain
+    matmul kernels, no index array)."""
     from distributed_sgd_tpu.data.rcv1 import Dataset
 
     n, d = int(1_000_000 * scale), 1024
     rng = np.random.default_rng(0)
-    idx = np.broadcast_to(np.arange(d, dtype=np.int32), (n, d)).copy()
     val = rng.normal(size=(n, d)).astype(np.float32) / np.sqrt(d)
     w_true = rng.normal(size=d).astype(np.float32)
     y = (val @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
-    data = Dataset(indices=idx, values=val, labels=y, n_features=d)
+    data = Dataset.dense(val, y)
     e, loss, _, spe = _sync_run(data, "least_squares", 1, 256, 0.05, 0.0, "none")
     return {"config": 5, "desc": "sync dense 1024-d least squares", "n": n,
             "epoch_s": round(e, 4), "steps_per_epoch": spe,

@@ -46,12 +46,42 @@ class Dataset:
     labels: np.ndarray  # int32[N], +/-1 (or float for regression)
     n_features: int
 
+    def __post_init__(self):
+        # a zero-width index array IS the dense-layout discriminator
+        # (batches carry no n_features, so width 0 must imply dense
+        # everywhere); sparse sets always pad to width >= 1 (pack_csr)
+        if self.indices.shape[1] == 0 and self.values.shape[1] != self.n_features:
+            raise ValueError(
+                "zero-width indices mean dense layout: values must span all "
+                f"{self.n_features} features, got width {self.values.shape[1]}"
+            )
+
     def __len__(self) -> int:
-        return self.indices.shape[0]
+        return self.values.shape[0]
 
     @property
     def pad_width(self) -> int:
-        return self.indices.shape[1]
+        return self.values.shape[1]
+
+    @property
+    def is_dense(self) -> bool:
+        """Dense layout: no index array (zero-width), values hold every
+        feature.  Engines route these rows through plain-matmul kernels
+        (models/linear.py dense fast path) instead of gather/scatter, and
+        the int32 index array — which would double the footprint — is never
+        materialized."""
+        return self.indices.shape[1] == 0 and self.values.shape[1] == self.n_features
+
+    @classmethod
+    def dense(cls, values: np.ndarray, labels: np.ndarray) -> "Dataset":
+        """Build a dense-layout dataset from values[N, D] + labels[N]."""
+        values = np.ascontiguousarray(values, dtype=np.float32)
+        return cls(
+            indices=np.empty((values.shape[0], 0), dtype=np.int32),
+            values=values,
+            labels=np.asarray(labels),
+            n_features=values.shape[1],
+        )
 
     def slice(self, sel) -> "Dataset":
         return Dataset(self.indices[sel], self.values[sel], self.labels[sel], self.n_features)
@@ -136,7 +166,10 @@ def pack_csr(
     nnz = np.diff(row_ptr).astype(np.int64)
     n = len(nnz)
     max_nnz = int(nnz.max()) if n else 0
-    p = int(pad_width) if pad_width else max_nnz
+    # width >= 1 always: a zero-width index array is the dense-layout
+    # discriminator (Dataset.is_dense), so an all-empty-rows sparse set
+    # pads to width 1 instead
+    p = int(pad_width) if pad_width else max(max_nnz, 1)
     out_idx = np.zeros((n, p), dtype=np.int32)
     out_val = np.zeros((n, p), dtype=np.float32)
 
@@ -169,8 +202,11 @@ def pack_csr(
 def dim_sparsity(train: "Dataset") -> np.ndarray:
     """Inverse-document-frequency vector: 1/(count_i + 1) where feature i
     appears in the train split, else 0 (Main.scala:54-65)."""
-    idx = train.indices[train.values != 0]
-    counts = np.bincount(idx.ravel(), minlength=train.n_features)
+    if train.is_dense:
+        counts = (train.values != 0).sum(axis=0)
+    else:
+        idx = train.indices[train.values != 0]
+        counts = np.bincount(idx.ravel(), minlength=train.n_features)
     out = np.zeros(train.n_features, dtype=np.float32)
     nz = counts > 0
     out[nz] = 1.0 / (counts[nz] + 1.0)

@@ -50,14 +50,14 @@ def dense_regression(
     noise: float = 0.01,
     seed: int = 0,
 ) -> Dataset:
-    """Dense least-squares data in the packed representation.
+    """Dense least-squares data in the dense layout (BASELINE.md config 5).
 
-    Every row stores all features (indices = arange), so the same sparse
-    kernels run it; labels are float targets (BASELINE.md config 5).
+    Uses `Dataset.dense`: values[N, D] only, no index array — engines route
+    it through the plain-matmul kernels (models/linear.py dense fast path)
+    and the int32 indices that would double the footprint never exist.
     """
     rng = np.random.default_rng(seed)
     x = rng.normal(size=(n_samples, n_features)).astype(np.float32)
     w_true = rng.normal(size=n_features).astype(np.float32)
     y = x @ w_true + noise * rng.normal(size=n_samples).astype(np.float32)
-    idx = np.broadcast_to(np.arange(n_features, dtype=np.int32), (n_samples, n_features)).copy()
-    return Dataset(indices=idx, values=x, labels=y.astype(np.float32), n_features=n_features)
+    return Dataset.dense(x, y.astype(np.float32))

@@ -81,6 +81,8 @@ class LinearModel:
 
     # -- shared ------------------------------------------------------------
     def margins(self, w: jax.Array, batch: SparseBatch) -> jax.Array:
+        if batch.is_dense:
+            return self.margins_dense(w, batch.values)
         return matvec(batch, w)
 
     def sample_losses(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
@@ -107,12 +109,46 @@ class LinearModel:
 
     def grad_sum(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
         """Sum of per-sample backward over the batch (Slave.scala:147-153)."""
+        if batch.is_dense:
+            return self.grad_dense(w, batch.values, y, reduce="sum")
         coeff = self.grad_coeff(self.margins(w, batch), y)
         return scatter_add(batch, coeff, self.n_features)
 
     def grad_mean(self, w: jax.Array, batch: SparseBatch, y: jax.Array) -> jax.Array:
         """Mean of per-sample backward (async path, Slave.scala:93-98)."""
         return self.grad_sum(w, batch, y) / batch.batch_size
+
+    # -- dense fast path ----------------------------------------------------
+    #
+    # When rows are fully dense (Dataset.dense layout: values[B, D], no
+    # index array), gather/scatter degenerate to plain matmuls — the shape
+    # the MXU was built for.  Same math as the sparse kernels on a row
+    # whose indices are arange(D) (BASELINE.md config 5).
+
+    def margins_dense(self, w: jax.Array, x: jax.Array) -> jax.Array:
+        """Per-sample dots for dense rows: x[B, D] @ w[D].
+
+        Precision HIGHEST keeps f32 products on TPU (default matmul
+        precision would truncate operands to bf16), preserving the
+        invariant that every kernel backend produces identical updates up
+        to float summation order (sync.py docstring)."""
+        return jnp.dot(
+            x.astype(jnp.float32), w.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    def grad_dense(
+        self, w: jax.Array, x: jax.Array, y: jax.Array, reduce: str = "sum"
+    ) -> jax.Array:
+        """Batched backward for dense rows: coeff[B] @ x[B, D] — one MXU
+        matmul replacing gather + scatter (Slave.scala:147-153 semantics)."""
+        coeff = self.grad_coeff(self.margins_dense(w, x), y)
+        if reduce == "mean":
+            coeff = coeff / x.shape[0]
+        return jnp.dot(
+            coeff.astype(jnp.float32), x.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        )
 
     def regularize(self, grad: jax.Array, w: jax.Array) -> jax.Array:
         """SparseSVM.scala:31 semantics (see module docstring)."""
@@ -174,7 +210,11 @@ class LinearModel:
         Slave.scala:142-157): one entry point for callers that hold dense
         weights, routed through the blocked MXU kernels when `blocked`.
         Engines that carry blocked weights across a scan call the blocked
-        methods directly instead."""
+        methods directly instead.  Dense-layout batches route to the
+        plain-matmul fast path regardless of `blocked`."""
+        if batch.is_dense:
+            g = self.grad_dense(w, batch.values, y, reduce=reduce)
+            return self.regularize(g, w)
         if blocked:
             w2 = mxu.to_blocked(w, self.n_features)
             g2 = self.grad_blocked(w2, batch, y, reduce=reduce)

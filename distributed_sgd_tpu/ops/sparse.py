@@ -48,11 +48,18 @@ class SparseBatch(NamedTuple):
 
     @property
     def batch_size(self) -> int:
-        return self.indices.shape[0]
+        return self.values.shape[0]
 
     @property
     def pad_width(self) -> int:
         return self.indices.shape[1]
+
+    @property
+    def is_dense(self) -> bool:
+        """Dense-layout batch (Dataset.dense): zero-width index array,
+        values hold every feature.  The canonical discriminator — model
+        methods route these rows to the plain-matmul kernels."""
+        return self.indices.shape[1] == 0
 
 
 def matvec(batch: SparseBatch, w: jax.Array) -> jax.Array:

@@ -106,6 +106,11 @@ class FeatureShardedEngine:
     # -- host API ----------------------------------------------------------
 
     def bind(self, data: Dataset):
+        if data.is_dense:
+            raise NotImplementedError(
+                "feature-sharded engine needs indexed (sparse-layout) rows; "
+                "dense-layout data runs on SyncEngine's dense kernel instead"
+            )
         total, _chunk = padded_layout(len(data), self.n_workers, 4096)
         padded = _pad_to_exact(data, total)
         self.shard_n = total // self.n_workers

@@ -98,7 +98,8 @@ class _Worker:
 
         from distributed_sgd_tpu.ops import mxu
 
-        blocked = mxu.blocked_pays_off(device)
+        dense = shard.is_dense
+        blocked = (not dense) and mxu.blocked_pays_off(device)
 
         k = self.k
 
@@ -123,6 +124,10 @@ class _Worker:
             def body(carry, kk):
                 w_t, acc = carry
                 ids = jax.random.randint(kk, (bs,), 0, shard_n)
+                if dense:
+                    g = model.grad_dense(w_t, val[ids], y[ids], reduce="mean")
+                    delta = learning_rate * model.regularize(g, w_t)
+                    return (w_t - delta, acc + delta), None
                 batch = SparseBatch(idx[ids], val[ids])
                 # MEAN (Slave.scala:93-98) + regularize (Slave.scala:99)
                 if blocked:

@@ -89,7 +89,8 @@ class LocalSGDEngine:
         bs, lr, h = self.batch_size, self.learning_rate, self.sync_period
         model = self.model
 
-        blocked = self.kernel == "mxu"
+        dense = train.is_dense  # dense layout routes to plain-matmul kernels
+        blocked = self.kernel == "mxu" and not dense
         n_features = model.n_features
 
         def round_shard(w, idx, val, y, key):
@@ -99,6 +100,9 @@ class LocalSGDEngine:
 
             def body(wl, t):
                 ids = jax.random.randint(jax.random.fold_in(key, t), (bs,), 0, shard_n)
+                if dense:
+                    g = model.grad_dense(wl, val[ids], y[ids], reduce="mean")
+                    return wl - lr * model.regularize(g, wl), ()
                 batch = SparseBatch(idx[ids], val[ids])
                 if blocked:
                     g = model.grad_blocked(wl, batch, y[ids], reduce="mean")

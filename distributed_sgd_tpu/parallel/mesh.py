@@ -43,8 +43,8 @@ def pad_to_multiple(data: Dataset, k: int) -> Dataset:
     rem = (-n) % k
     if rem == 0:
         return data
-    pad_idx = np.zeros((rem, data.pad_width), dtype=data.indices.dtype)
-    pad_val = np.zeros((rem, data.pad_width), dtype=data.values.dtype)
+    pad_idx = np.zeros((rem, data.indices.shape[1]), dtype=data.indices.dtype)
+    pad_val = np.zeros((rem, data.values.shape[1]), dtype=data.values.dtype)
     pad_y = np.zeros((rem,), dtype=data.labels.dtype)
     return Dataset(
         indices=np.concatenate([data.indices, pad_idx]),

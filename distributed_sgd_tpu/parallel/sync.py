@@ -25,9 +25,11 @@ the hand-fused single-launch version of the same formulation
 (ops/pallas_sparse.py — ~109 us at the same config: beats scalar 3x but
 trails XLA's fusion of the big-matmul form; kept as a first-class backend
 and the starting point for shapes where manual fusion wins); 'scalar' is
-the reference-shaped take/scatter path (ops/sparse.py).  All produce
+the reference-shaped take/scatter path (ops/sparse.py); 'dense' runs
+dense-layout datasets (Dataset.dense — no index array) as plain [B, D]
+matmuls, auto-selected at bind().  All produce
 identical updates up to float summation order (tests/test_mxu_kernels.py,
-tests/test_pallas_kernels.py).
+tests/test_pallas_kernels.py, tests/test_dense_path.py).
 
 Batch sampling mirrors Master.scala:184 (`split.map(Random.shuffle(_))`
 then slice): every step each worker draws a fresh uniform batch from its
@@ -66,6 +68,11 @@ class ShardedData(NamedTuple):
     labels: jax.Array  # [N_pad], sharded over workers; 0 = padding mask
     n_true: int  # real sample count (host-side)
 
+    @property
+    def is_dense(self) -> bool:
+        """Dense layout (Dataset.dense): zero-width index array."""
+        return self.indices.shape[1] == 0
+
 
 class BoundSync:
     """Sync engine bound to one dataset's shapes: jitted epoch/eval/step."""
@@ -85,9 +92,15 @@ class BoundSync:
     ):
         if sampling not in ("fresh", "epoch"):
             raise ValueError(f"sampling must be 'fresh' or 'epoch', got {sampling!r}")
-        if kernel not in ("mxu", "scalar", "pallas"):
+        if kernel not in ("mxu", "scalar", "pallas", "dense"):
             raise ValueError(
-                f"kernel must be 'mxu', 'scalar' or 'pallas', got {kernel!r}"
+                f"kernel must be 'mxu', 'scalar', 'pallas' or 'dense', got {kernel!r}"
+            )
+        dense_data = data.is_dense
+        if (kernel == "dense") != dense_data:
+            raise ValueError(
+                f"kernel='dense' goes with dense-layout data (Dataset.dense) and "
+                f"vice versa; got kernel={kernel!r}, dense data={dense_data}"
             )
         self.kernel = kernel
         # the Pallas kernel needs the interpreter off-TPU (tests, CPU mesh).
@@ -183,6 +196,9 @@ class BoundSync:
     def _worker_grad(self, w, batch, by):
         """One reference worker's Gradient reply: per-sample backward SUM +
         regularize at this worker's grad support (Slave.scala:142-157)."""
+        if self.kernel == "dense":
+            g = self.model.grad_dense(w, batch.values, by)
+            return self.model.regularize(g, w)
         if self.kernel == "mxu":
             g = self.model.grad_blocked(w, batch, by)
             return self.model.regularize_blocked(g, w)
@@ -249,8 +265,11 @@ class BoundSync:
 
         The blocked path computes the gather as one-hot MXU matmuls over a
         512-sample sub-scan (bounds the [T, R] one-hot working set while
-        keeping matmuls large); the scalar path is a plain take-gather.
+        keeping matmuls large); the scalar path is a plain take-gather; the
+        dense path is one [B, D] @ [D] matmul.
         """
+        if self.kernel == "dense":
+            return self.model.margins_dense(w_layout, batch.values)
         if not self._blocked_layout:
             return self.model.margins(w_layout, batch)
         sub = 512
@@ -414,6 +433,9 @@ class SyncEngine:
         n_true = len(data)
         if n_true < n_workers:
             raise ValueError(f"dataset of {n_true} rows < {n_workers} workers")
+        # dense-layout data can only run the dense matmul kernels (there is
+        # no index array to gather with), so auto-route it there
+        kernel = "dense" if data.is_dense else self.kernel
         total, chunk = padded_layout(n_true, n_workers, self.eval_chunk)
         padded = _pad_to_exact(data, total)
         sharding = NamedSharding(self.mesh, P(AXIS))
@@ -432,7 +454,7 @@ class SyncEngine:
             sampling=self.sampling,
             steps_per_epoch=steps_per_epoch,
             eval_chunk=chunk,
-            kernel=self.kernel,
+            kernel=kernel,
             virtual_workers=self.virtual_workers,
         )
 
@@ -457,10 +479,10 @@ def _pad_to_exact(data: Dataset, target: int) -> Dataset:
         return data
     return Dataset(
         indices=np.concatenate(
-            [data.indices, np.zeros((rem, data.pad_width), dtype=data.indices.dtype)]
+            [data.indices, np.zeros((rem, data.indices.shape[1]), dtype=data.indices.dtype)]
         ),
         values=np.concatenate(
-            [data.values, np.zeros((rem, data.pad_width), dtype=data.values.dtype)]
+            [data.values, np.zeros((rem, data.values.shape[1]), dtype=data.values.dtype)]
         ),
         labels=np.concatenate([data.labels, np.zeros((rem,), dtype=data.labels.dtype)]),
         n_features=data.n_features,

@@ -127,5 +127,5 @@ def test_rcv1_like_stats():
 def test_dense_regression_shapes():
     ds = dense_regression(16, n_features=8, seed=0)
     assert ds.values.shape == (16, 8)
-    assert ds.indices[0].tolist() == list(range(8))
+    assert ds.is_dense and ds.indices.shape == (16, 0)  # no index array
     assert ds.labels.dtype == np.float32
