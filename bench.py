@@ -16,30 +16,26 @@ remote-TPU tunnel adds ~100 ms per call) is excluded: epoch_s =
 (t[3 epochs] - t[1 epoch]) / 2, with device->host pulls forcing real
 synchronization around each timed region.
 
-vs_baseline: the reference publishes no numbers (SURVEY.md §6), so the
-baseline is MODELED from the reference's own algorithm structure
-(Master.scala:179-198), conservatively in the JVM's favor:
+vs_baseline (the HEADLINE) is fully measured — no modeled constants: it
+is the wall-clock of the reference's boxed-map sync algorithm run end to
+end on this host (benches/boxed_baseline.py: same dict-of-float data
+structures and formulas as the reference's spire.Number maps, single
+process, zero serialization, workers sequential — every simplification
+favors the floor), extrapolated from a measured steady-state window of
+the full-scale epoch, divided by the TPU epoch.  A workers-parallel
+variant (the whole floor divided by nodeCount, more than fair — the
+master reduce is serial in the reference) is reported alongside.
 
- 1. worker compute  — the per-sample boxed sparse-map backward loop
-    (Slave.scala:147-152 semantics) timed in python on this host, divided
-    by JVM_SPEEDUP=10 (a generous python->Scala factor given the reference
-    uses boxed spire.math.Number maps, typically no faster than python
-    floats in dicts), divided by nodeCount (workers run in parallel);
- 2. master reduce   — Vec.mean over nodeCount sparse worker grads + the
-    weight update (Master.scala:194-197), timed in python as dict merges,
-    divided by JVM_SPEEDUP (serial, on the master);
- 3. wire codecs     — every batch the master serializes the FULL sparse
-    weight vector once per worker and each worker deserializes it, and
-    each worker serializes its gradient reply which the master
-    deserializes (proto map<int32,double>, proto.proto:28-31;
-    Master.scala:184-189).  Bytes are counted exactly (13 B/entry, weight
-    density evolved by the coupon-collector union over sampled features)
-    and charged at WIRE_GBPS=1.0 GB/s end-to-end — far faster than
-    ScalaPB boxed-map codecs achieve in practice.  Network transit itself
-    is charged at zero.
+The JVM model of round 1 is kept as SECONDARY diagnostics, clearly
+labeled as modeled: worker compute and master reduce timed in python and
+divided by JVM_SPEEDUP=10, plus an exact wire byte count charged at
+1 GB/s.  Because the wire term dominates that model and rests on an
+assumed throughput, the JSON reports a sensitivity range (wire charged at
+1 and 10 GB/s) and a compute+reduce-only ratio with the wire term
+dropped entirely.
 
-Items the real reference also pays that are deliberately EXCLUDED (each
-would only raise the baseline): per-epoch full-dataset master eval
+Items the real reference also pays that every view EXCLUDES (each would
+only raise the baseline): per-epoch full-dataset master eval
 (Master.scala:201-209), gRPC framing/HTTP2, STM/executor overhead, GC.
 
 Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
@@ -141,6 +137,39 @@ def _expected_w_nnz(batches_done: int) -> float:
     return N_FEATURES * (1.0 - math.exp(-draws / N_FEATURES))
 
 
+def boxed_floor_epoch_seconds(idx, val, y, window_batches: int = 40) -> dict:
+    """MEASURED boxed-map floor (benches/boxed_baseline.py) on a
+    steady-state window of the full-scale epoch, extrapolated linearly.
+
+    The window starts from w=0 and densifies within ~5 batches (each batch
+    draws N_WORKERS*BATCH*NNZ ~ 23k of 47k features), so the early cheap
+    batches make the extrapolation favor the floor."""
+    from benches.boxed_baseline import boxed_epoch, rows_from_packed
+
+    # the per-batch cost is sample-count-independent (fixed batch size),
+    # so measure on a slice large enough to sample from
+    n_slice = min(len(y), 60_000)
+    rows = rows_from_packed(idx[:n_slice], val[:n_slice])
+    ys = [int(v) for v in y[:n_slice]]
+    counts = np.bincount(idx.ravel(), minlength=N_FEATURES)
+    ds = {int(i): 1.0 / (c + 1.0) for i, c in enumerate(counts) if c > 0}
+
+    _w, stats = boxed_epoch(
+        rows, ys, N_WORKERS, BATCH, lr=LR, lam=LAM, ds=ds,
+        max_batches=window_batches,
+    )
+    # extrapolate the measured window rate to the FULL epoch's step count
+    per_batch = stats["wall_s"] / stats["batches_done"]
+    epoch_s = per_batch * STEPS_PER_EPOCH
+    log(
+        f"boxed floor: {stats['wall_s']:.2f}s / {stats['batches_done']} batches "
+        f"({per_batch*1e3:.1f} ms/batch) -> {epoch_s:.1f}s/epoch measured floor "
+        f"({epoch_s / N_WORKERS:.1f}s if all worker compute were perfectly parallel)"
+    )
+    return {"total": epoch_s, "per_batch": per_batch,
+            "workers_parallel_bound": epoch_s / N_WORKERS}
+
+
 def baseline_epoch_seconds(idx, val, y, sample: int = 400) -> dict:
     """Model of one reference epoch (see module docstring)."""
     n = len(y)
@@ -211,18 +240,32 @@ def main() -> None:
     idx, val, y = gen_data(N_SAMPLES)
     log(f"generated in {time.perf_counter()-t0:.1f}s")
 
-    baseline = baseline_epoch_seconds(idx, val, y)
+    floor = boxed_floor_epoch_seconds(idx, val, y)
+    model = baseline_epoch_seconds(idx, val, y)
     epoch_s, loss, acc = tpu_epoch_seconds(idx, val, y)
+
+    # JVM-model views (all labeled as modeled): wire-speed sensitivity
+    # range + a ratio with the modeled wire term dropped entirely
+    model_wire10 = model["compute"] + model["reduce"] + model["wire"] / 10.0
+    model_no_wire = model["compute"] + model["reduce"]
 
     print(json.dumps({
         "metric": "rcv1_sync_epoch_seconds",
         "value": round(epoch_s, 4),
         "unit": "s",
-        "vs_baseline": round(baseline["total"] / epoch_s, 2),
+        # headline: fully measured (boxed-map floor, this host) / measured TPU
+        "vs_baseline": round(floor["total"] / epoch_s, 2),
+        "baseline_kind": "measured_boxed_floor",
+        "vs_boxed_floor_workers_parallel": round(
+            floor["workers_parallel_bound"] / epoch_s, 2),
+        "boxed_floor_epoch_seconds": round(floor["total"], 2),
+        # secondary, MODELED views (JVM factor 10 + assumed wire speed)
+        "vs_jvm_model_wire_1gbps": round(model["total"] / epoch_s, 2),
+        "vs_jvm_model_wire_10gbps": round(model_wire10 / epoch_s, 2),
+        "vs_jvm_model_compute_reduce_only": round(model_no_wire / epoch_s, 2),
+        "jvm_model_breakdown_s": {k2: round(v, 2) for k2, v in model.items()},
         "final_loss": round(float(loss), 4),
         "final_acc": round(float(acc), 4),
-        "baseline_epoch_seconds_jvm_model": round(baseline["total"], 2),
-        "baseline_breakdown_s": {k2: round(v, 2) for k2, v in baseline.items()},
         "n_samples": N_SAMPLES,
         "n_features": N_FEATURES,
         "batch_size": BATCH,

@@ -1,0 +1,154 @@
+"""Measured boxed-map baseline: the reference's sync algorithm, end to end.
+
+This is the HONEST floor for bench.py's headline ratio: the reference's
+sync training loop (Master.scala:179-198 + Slave.scala:142-157) run for
+real on boxed python dicts — the same data structures and formulas as the
+parity oracle (tests/test_reference_oracle.py), promoted to a runnable
+end-to-end epoch trainer.  Nothing is modeled or scaled: the number this
+reports is a wall-clock measurement of the boxed-map algorithm on this
+host.  Every deviation from the real reference FAVORS the floor:
+
+- single process, zero serialization / RPC / network (the reference ships
+  the full sparse weight vector per worker per batch, Master.scala:184-189);
+- workers run sequentially and their compute is NOT divided by any
+  parallelism factor inside the timed region (the caller may report a
+  workers-parallel view separately, labeled as such);
+- no per-epoch master eval (the reference does 4 full-dataset passes per
+  epoch, Master.scala:201-209);
+- python dict-of-float vs the reference's boxed spire.math.Number maps
+  (arbitrary-precision boxed arithmetic, typically no faster than python
+  floats in dicts).
+
+Per-batch step (reference semantics, verbatim):
+  worker: per-sample backward (0 if y*(x.w) < 0 else y*x), SUMMED over the
+  batch, + lambda*2*(w . dimSparsity) at the grad's stored keys;
+  master: keyset-union mean over worker replies, w <- w - lr*mean.
+
+Usage:
+  python benches/boxed_baseline.py [--n 80000] [--batches 100] [--workers 3]
+Prints one JSON line; --batches caps the measured window (rates are
+steady-state-linear in batch count, so the caller may extrapolate, and the
+JSON reports both the measured window and the extrapolated full epoch).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+
+def boxed_worker_grad(w: dict, rows, ys, ids, ds: dict, lam: float) -> dict:
+    """One worker's Gradient reply on boxed maps (Slave.scala:142-157)."""
+    grad: dict = {}
+    for i in ids:
+        x, yi = rows[i], ys[i]
+        dot = 0.0
+        for k, v in x.items():  # Sparse dot (Sparse.scala:15-46)
+            dot += v * w.get(k, 0.0)
+        if yi * dot >= 0:  # backward = y*x unless y*(x.w) < 0
+            for k, v in x.items():
+                grad[k] = grad.get(k, 0.0) + yi * v
+    grad = {k: v for k, v in grad.items() if v != 0.0}  # Sparse drops zeros
+    # regularize: + lambda*2*(w . dimSparsity) at grad's stored keys
+    scalar = 0.0
+    for k, wv in w.items():
+        scalar += wv * ds.get(k, 0.0)
+    scalar *= lam * 2.0
+    return {k: v + scalar for k, v in grad.items()}
+
+
+def boxed_epoch(
+    rows,
+    ys,
+    n_workers: int,
+    batch: int,
+    lr: float,
+    lam: float,
+    ds: dict,
+    w: dict | None = None,
+    max_batches: int | None = None,
+    rng: np.random.Generator | None = None,
+):
+    """Run (up to max_batches of) one sync epoch on boxed maps; returns
+    (w, stats) where stats carries the measured wall-clock and counts."""
+    n = len(rows)
+    w = {} if w is None else w
+    rng = rng or np.random.default_rng(0)
+    shard = math.ceil(n / n_workers)
+    splits = [list(range(k * shard, min((k + 1) * shard, n))) for k in range(n_workers)]
+    steps = math.ceil(shard / batch)
+    todo = steps if max_batches is None else min(steps, max_batches)
+
+    t0 = time.perf_counter()
+    for _t in range(todo):
+        grads = []
+        for split in splits:  # workers (sequential here; see module doc)
+            ids = rng.choice(split, size=min(batch, len(split)), replace=False)
+            grads.append(boxed_worker_grad(w, rows, ys, ids, ds, lam))
+        # master: keyset-union mean + update (Master.scala:194-197)
+        keys = set().union(*[g.keys() for g in grads])
+        for k in keys:
+            w[k] = w.get(k, 0.0) - lr * sum(g.get(k, 0.0) for g in grads) / n_workers
+    wall = time.perf_counter() - t0
+    return w, {
+        "wall_s": wall,
+        "batches_done": todo,
+        "steps_per_epoch": steps,
+        "samples_done": todo * n_workers * batch,
+        "epoch_s_extrapolated": wall * steps / max(todo, 1),
+        "w_nnz": len(w),
+    }
+
+
+def boxed_loss(w: dict, rows, ys, lam: float) -> float:
+    """Objective: lambda*||w||^2 + mean hinge on the sign-quirk prediction."""
+    losses = []
+    for x, yi in zip(rows, ys):
+        dot = sum(v * w.get(k, 0.0) for k, v in x.items())
+        pred = -float(np.sign(dot))
+        losses.append(max(0.0, 1.0 - yi * pred))
+    return lam * sum(v * v for v in w.values()) + float(np.mean(losses))
+
+
+def rows_from_packed(idx: np.ndarray, val: np.ndarray):
+    """Packed [N, P] arrays -> list of {feature: value} boxed rows."""
+    out = []
+    for i in range(len(idx)):
+        out.append({int(k): float(v) for k, v in zip(idx[i], val[i]) if v != 0.0})
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=80_000)
+    ap.add_argument("--batches", type=int, default=100)
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=100)
+    args = ap.parse_args()
+
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    from distributed_sgd_tpu.data.rcv1 import dim_sparsity
+    from distributed_sgd_tpu.data.synthetic import rcv1_like
+
+    data = rcv1_like(args.n, seed=0)
+    rows = rows_from_packed(data.indices, data.values)
+    ys = [int(y) for y in data.labels]
+    ds_vec = dim_sparsity(data)
+    ds = {i: float(v) for i, v in enumerate(ds_vec) if v != 0.0}
+
+    w, stats = boxed_epoch(
+        rows, ys, args.workers, args.batch, lr=0.5, lam=1e-5, ds=ds,
+        max_batches=args.batches,
+    )
+    stats["loss_after_window"] = round(boxed_loss(w, rows[:5000], ys[:5000], 1e-5), 4)
+    stats = {k: round(v, 4) if isinstance(v, float) else v for k, v in stats.items()}
+    print(json.dumps({"metric": "boxed_floor", "n": args.n, **stats}))
+
+
+if __name__ == "__main__":
+    main()

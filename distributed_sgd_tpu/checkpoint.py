@@ -2,11 +2,16 @@
 
 A strict capability superset of the reference, which persists nothing —
 its only recovery mechanism is the async master's in-memory best-weights
-tracking (MasterAsync.scala:66-69,130-139; SURVEY.md §5.4).  Here training
-state (weights + step + loss histories) checkpoints to disk at an epoch
-cadence and can resume mid-run; the async engines' best-weights snapshot
-is also persisted so the reference's "return best" behavior survives a
-process restart.
+tracking (MasterAsync.scala:66-69,130-139; SURVEY.md §5.4).  Wiring
+(`Config.checkpoint_dir`, built in main.py):
+
+- SyncTrainer saves weights every `checkpoint_every` epochs and resumes
+  from the latest snapshot (continuing the same batch-sampling stream);
+- the async drivers (Hogwild gossip, local-SGD, gRPC MasterNode.fit_async)
+  hand their Checkpointer to LossChecker, which persists each NEW
+  best-weights snapshot — so the reference's "return best" behavior
+  survives a process kill — and main.py feeds the latest snapshot back as
+  `initial_weights` on restart.
 """
 
 from __future__ import annotations
@@ -40,13 +45,20 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(max_to_keep=keep, create=True),
         )
 
-    def save(self, step: int, weights, extra: Optional[Dict[str, Any]] = None) -> None:
+    def save(self, step: int, weights, extra: Optional[Dict[str, Any]] = None) -> bool:
         state = {"weights": np.asarray(weights)}
         if extra:
             state.update({k: np.asarray(v) for k, v in extra.items()})
-        self._mgr.save(step, args=ocp.args.StandardSave(state))
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
         self._mgr.wait_until_finished()
-        log.info("checkpoint saved at step %d -> %s", step, self.directory)
+        if saved:
+            log.info("checkpoint saved at step %d -> %s", step, self.directory)
+        else:  # orbax declines e.g. writes to an already-existing step
+            log.warning("checkpoint at step %d NOT saved (step exists?)", step)
+        return bool(saved)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
 
     def restore_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
         step = self._mgr.latest_step()

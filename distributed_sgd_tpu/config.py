@@ -52,17 +52,39 @@ class Config:
     patience: int = 5
 
     # -- TPU-native extensions ---------------------------------------------
-    model: str = "hinge"  # hinge | logistic | least_squares
+    model: str = "hinge"  # hinge | svm | logistic | least_squares
     seed: int = 0
+    engine: str = "mesh"  # mesh (XLA collectives) | rpc (gRPC parity topology)
     async_mode: str = "gossip"  # gossip | local_sgd
     sync_period: int = 16  # local-SGD averaging period (steps)
     checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1  # sync-trainer epoch cadence
     heartbeat_s: Optional[float] = None  # master worker-failure detection period
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     profile_dir: Optional[str] = None  # jax.profiler trace output
     pad_width: Optional[int] = None  # sparse-batch nnz padding (None = auto)
     kernel: str = "mxu"  # mxu | scalar | pallas (sync-engine sparse kernels)
     virtual_workers: int = 1  # reference workers emulated per mesh device
+
+    _CHOICES = {
+        "model": ("hinge", "svm", "logistic", "least_squares"),
+        "engine": ("mesh", "rpc"),
+        "async_mode": ("gossip", "local_sgd"),
+        # 'dense' is auto-selected from the data layout, never configured
+        "kernel": ("mxu", "scalar", "pallas"),
+    }
+
+    def __post_init__(self):
+        for name, choices in self._CHOICES.items():
+            v = getattr(self, name)
+            if v not in choices:
+                raise ValueError(
+                    f"config field {name}={v!r} must be one of {choices}"
+                )
+        if self.virtual_workers < 1:
+            raise ValueError("virtual_workers must be >= 1")
+        if self.checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
 
     @property
     def role(self) -> str:
@@ -96,9 +118,11 @@ class Config:
             patience=_env("DSGD_PATIENCE", cls.patience, int),
             model=_env("DSGD_MODEL", cls.model, str),
             seed=_env("DSGD_SEED", cls.seed, int),
+            engine=_env("DSGD_ENGINE", cls.engine, str),
             async_mode=_env("DSGD_ASYNC_MODE", cls.async_mode, str),
             sync_period=_env("DSGD_SYNC_PERIOD", cls.sync_period, int),
             checkpoint_dir=_env("DSGD_CHECKPOINT_DIR", None, str),
+            checkpoint_every=_env("DSGD_CHECKPOINT_EVERY", cls.checkpoint_every, int),
             heartbeat_s=_env("DSGD_HEARTBEAT_S", None, float),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),

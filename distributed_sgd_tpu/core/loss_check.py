@@ -17,18 +17,47 @@ from distributed_sgd_tpu.core.early_stopping import Criterion
 
 
 class LossChecker:
-    def __init__(self, leaky_loss: float, criterion: Optional[Criterion] = None):
+    def __init__(
+        self,
+        leaky_loss: float,
+        criterion: Optional[Criterion] = None,
+        checkpointer=None,
+    ):
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
         self.leaky = leaky_loss
         self.criterion = criterion
+        # optional checkpoint.Checkpointer: each new best-weights snapshot
+        # is persisted, so the reference's "return best" behavior
+        # (MasterAsync.scala:87-94) survives a process restart.  Saves are
+        # offset past the directory's latest step: a resumed run's fresh
+        # step counter must not save below the previous run's snapshots
+        # (restore_latest picks the max step)
+        self.checkpointer = checkpointer
+        self._step_base = 0
         self.smoothed: List[float] = []  # newest first
         self.smoothed_accs: List[float] = []  # newest first
         self.best_loss = float("inf")
         self.best_weights: Optional[np.ndarray] = None
+        if checkpointer is not None:
+            restored = checkpointer.restore_latest()
+            if restored is not None:
+                step, state = restored
+                # saves land strictly past the prior run's steps (orbax
+                # silently drops writes to an existing step)
+                self._step_base = step + 1
+                # seed best-(loss, weights) from the snapshot so a resumed
+                # run's first (possibly worse) check cannot shadow the
+                # prior run's true best at a higher step
+                if "best_loss" in state:
+                    self.best_loss = float(state["best_loss"])
+                    self.best_weights = np.asarray(state["weights"])
 
-    def check(self, raw_loss: float, raw_acc: float, weights) -> bool:
-        """Record one evaluation; returns True if training should stop."""
+    def check(self, raw_loss: float, raw_acc: float, weights, step: Optional[int] = None) -> bool:
+        """Record one evaluation; returns True if training should stop.
+
+        `step` (e.g. the update count) labels the persisted checkpoint; it
+        defaults to the number of checks so far."""
         prev = self.smoothed[0] if self.smoothed else raw_loss
         loss = self.leaky * raw_loss + (1 - self.leaky) * prev
         prev_acc = self.smoothed_accs[0] if self.smoothed_accs else raw_acc
@@ -38,6 +67,12 @@ class LossChecker:
         if loss < self.best_loss:  # MasterAsync.scala:130-139
             self.best_loss = loss
             self.best_weights = np.asarray(weights)
+            if self.checkpointer is not None:
+                self.checkpointer.save(
+                    self._step_base + (step if step is not None else len(self.smoothed)),
+                    self.best_weights,
+                    extra={"best_loss": loss},
+                )
         return self.criterion is not None and self.criterion(self.smoothed)
 
     @property

@@ -463,6 +463,7 @@ class MasterNode:
         backoff_s: float = 2.5,
         split: SplitFn = vanilla_split,
         initial_weights: Optional[np.ndarray] = None,
+        checkpointer=None,
     ) -> FitResult:
         self._require_ready()
         if self._async_running.is_set():
@@ -494,7 +495,7 @@ class MasterNode:
             )
         self.log.info("waiting for slaves updates")
 
-        checker = LossChecker(leaky_loss, criterion)
+        checker = LossChecker(leaky_loss, criterion, checkpointer=checkpointer)
         result = FitResult(state=GradState(weights=w0))
         last_step = -check_every
         while self._async_running.is_set():
@@ -505,7 +506,7 @@ class MasterNode:
                 self._async_running.wait(backoff_s)
                 continue
             raw_loss, raw_acc = self.local_loss(w_now, test=True)
-            stop = checker.check(raw_loss, raw_acc, w_now)
+            stop = checker.check(raw_loss, raw_acc, w_now, step=updates)
             self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
             self.log.info(
                 "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",

@@ -148,6 +148,15 @@ class SyncTrainer:
         else:
             if max_epochs > 0:
                 log.info("Reached max number of epochs: stopping computation")
+        # the fit may end off-cadence (early stop, or max_epochs not a
+        # multiple of checkpoint_every): persist the final state so no run
+        # with a checkpointer ends unsaved
+        if (
+            self.checkpointer is not None
+            and result.epochs_run > start_epoch
+            and result.epochs_run % self.checkpoint_every != 0
+        ):
+            self.checkpointer.save(result.epochs_run, w)
         if self.profile_dir is not None and not profiled:
             log.warning(
                 "no profiler trace captured: the fit stopped before epoch %d",

@@ -65,6 +65,30 @@ def build(cfg: Config):
     return train, test, model
 
 
+def _make_checkpointer(cfg: Config):
+    """cfg.checkpoint_dir -> Checkpointer (or None): the sync trainer saves
+    at cfg.checkpoint_every epoch cadence and resumes from the latest
+    snapshot; async engines persist each new best-weights snapshot via
+    their LossChecker and resume from the latest best."""
+    if not cfg.checkpoint_dir:
+        return None
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    return Checkpointer(cfg.checkpoint_dir)
+
+
+def _restore_weights(ckpt):
+    """Latest checkpointed weights (for async resume), or None."""
+    if ckpt is None:
+        return None
+    restored = ckpt.restore_latest()
+    if restored is None:
+        return None
+    step, state = restored
+    log.info("resuming async fit from checkpoint at step %d", step)
+    return np.asarray(state["weights"])
+
+
 def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     """Dev-mode fast path: in-mesh engines, no RPC data plane."""
     from distributed_sgd_tpu.parallel.mesh import make_mesh
@@ -97,15 +121,17 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
         n, virtual, cfg.kernel, cfg.model, cfg.use_async,
     )
 
+    ckpt = _make_checkpointer(cfg)
     if cfg.use_async and cfg.async_mode == "gossip":
         from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
 
         eng = HogwildEngine(
             model, n_workers=cfg.node_count, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, check_every=cfg.check_every,
-            leaky_loss=cfg.leaky_loss, seed=cfg.seed,
+            leaky_loss=cfg.leaky_loss, seed=cfg.seed, checkpointer=ckpt,
         )
-        res = eng.fit(train, test, cfg.max_epochs, criterion)
+        res = eng.fit(train, test, cfg.max_epochs, criterion,
+                      initial_weights=_restore_weights(ckpt))
     elif cfg.use_async:
         from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
 
@@ -117,9 +143,10 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             model, mesh, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, sync_period=cfg.sync_period,
             check_every=cfg.check_every, leaky_loss=cfg.leaky_loss, seed=cfg.seed,
-            kernel=kernel,
+            kernel=kernel, checkpointer=ckpt,
         )
-        res = eng.fit(train, test, cfg.max_epochs, criterion)
+        res = eng.fit(train, test, cfg.max_epochs, criterion,
+                      initial_weights=_restore_weights(ckpt))
     else:
         from distributed_sgd_tpu.core.trainer import SyncTrainer
 
@@ -127,10 +154,11 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             model, mesh, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, seed=cfg.seed,
             kernel=cfg.kernel, virtual_workers=virtual,
+            checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
         )
         res = trainer.fit(train, test, cfg.max_epochs, criterion)
 
-    _finish(cfg, res)
+    _finish(cfg, res, saved=ckpt is not None)
 
 
 def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
@@ -143,18 +171,23 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
         if cfg.use_async:
+            ckpt = _make_checkpointer(cfg)
             res = c.master.fit_async(
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
+                initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
             )
+            saved = ckpt is not None
         else:
             res = c.master.fit_sync(
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion
             )
-        _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True))
+            saved = False
+        _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True),
+                saved=saved)
 
 
-def _finish(cfg: Config, res, evaluator=None) -> None:
+def _finish(cfg: Config, res, evaluator=None, saved: bool = False) -> None:
     w = res.state.weights
     log.info("fit done: %d epochs, final loss=%.6f, %d updates",
              res.epochs_run, res.state.loss, res.state.updates)
@@ -163,7 +196,9 @@ def _finish(cfg: Config, res, evaluator=None) -> None:
     else:
         tl, ta = evaluator(np.asarray(w))
         log.info("final test loss=%.6f acc=%.4f", tl, ta)
-    if cfg.checkpoint_dir:
+    # exit-time snapshot for paths without in-fit checkpoint wiring (the
+    # RPC scenario's sync fit); wired paths already saved during the fit
+    if cfg.checkpoint_dir and not saved:
         from distributed_sgd_tpu.checkpoint import Checkpointer
 
         Checkpointer(cfg.checkpoint_dir).save(res.epochs_run, w)
@@ -186,8 +221,7 @@ def main() -> None:
     role = cfg.role
     if role == "dev":
         train, test, model = build(cfg)
-        engine = os.environ.get("DSGD_ENGINE", "mesh")
-        if engine == "rpc":
+        if cfg.engine == "rpc":
             scenario_rpc(cfg, train, test, model)
         else:
             scenario_mesh(cfg, train, test, model)
@@ -202,13 +236,18 @@ def main() -> None:
         criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
         master.await_ready()
         if cfg.use_async:
+            ckpt = _make_checkpointer(cfg)
             res = master.fit_async(
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
+                initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
             )
+            saved = ckpt is not None
         else:
             res = master.fit_sync(cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion)
-        _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True))
+            saved = False
+        _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True),
+                saved=saved)
         master.stop()
     else:  # worker
         from distributed_sgd_tpu.core.worker import WorkerNode

@@ -234,6 +234,7 @@ class HogwildEngine:
         seed: int = 0,
         metrics: Optional[metrics_mod.Metrics] = None,
         steps_per_dispatch: int = 1,
+        checkpointer=None,
     ):
         """steps_per_dispatch=k amortizes host dispatch: each worker runs k
         local SGD steps in one compiled program and gossips the summed
@@ -253,6 +254,7 @@ class HogwildEngine:
         self.leaky_loss = leaky_loss
         self.backoff_s = backoff_s
         self.steps_per_dispatch = int(steps_per_dispatch)
+        self.checkpointer = checkpointer  # persists best weights (LossChecker)
         self.seed = seed
         self.metrics = metrics or metrics_mod.global_metrics()
         devs = list(devices if devices is not None else jax.devices())
@@ -320,7 +322,7 @@ class HogwildEngine:
         eval_bound = SyncEngine(self.model, make_mesh(1), self.batch_size, 0.0).bind(test)
 
         result = FitResult(state=GradState(weights=self._w_master))
-        checker = LossChecker(self.leaky_loss, criterion)
+        checker = LossChecker(self.leaky_loss, criterion, checkpointer=self.checkpointer)
         t_start = time.time()
 
         for w in workers:
@@ -336,7 +338,7 @@ class HogwildEngine:
                     self._stop.wait(self.backoff_s)
                     continue
                 raw_loss, raw_acc = eval_bound.evaluate(w_now)
-                stop = checker.check(raw_loss, raw_acc, w_now)
+                stop = checker.check(raw_loss, raw_acc, w_now, step=updates)
                 self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
                 log.info(
                     "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",

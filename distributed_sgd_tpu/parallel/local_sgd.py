@@ -56,6 +56,7 @@ class LocalSGDEngine:
         seed: int = 0,
         metrics: Optional[metrics_mod.Metrics] = None,
         kernel: str = "mxu",
+        checkpointer=None,
     ):
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
@@ -71,6 +72,7 @@ class LocalSGDEngine:
         self.leaky_loss = leaky_loss
         self.seed = seed
         self.metrics = metrics or metrics_mod.global_metrics()
+        self.checkpointer = checkpointer  # persists best weights (LossChecker)
         self.n_workers = mesh.shape[AXIS]
 
     def fit(
@@ -133,7 +135,7 @@ class LocalSGDEngine:
         )
         key = jax.random.PRNGKey(self.seed)
         result = FitResult(state=GradState(weights=w))
-        checker = LossChecker(self.leaky_loss, criterion)
+        checker = LossChecker(self.leaky_loss, criterion, checkpointer=self.checkpointer)
         steps_done, last_check = 0, -self.check_every
         t_start = time.time()
 
@@ -149,7 +151,7 @@ class LocalSGDEngine:
             if steps_done - last_check < self.check_every:
                 continue
             raw_loss, raw_acc = eval_bound.evaluate(w)
-            stop = checker.check(raw_loss, raw_acc, w)
+            stop = checker.check(raw_loss, raw_acc, w, step=steps_done)
             log.info(
                 "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
                 steps_done, checker.smoothed[0], checker.smoothed_accs[0],

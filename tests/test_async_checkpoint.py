@@ -1,0 +1,157 @@
+"""Async best-weights persistence + resume (VERDICT round-1 item 5).
+
+The reference's async mode returns its best-so-far weights from memory
+(MasterAsync.scala:87-94); here the LossChecker persists each new best to
+orbax, so a killed process resumes from its best snapshot.  These tests
+run a short fit, "kill" it (drop the engine), then resume a fresh engine
+from the restored snapshot and check the state carried over.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.checkpoint import Checkpointer
+from distributed_sgd_tpu.config import Config
+from distributed_sgd_tpu.core.loss_check import LossChecker
+from distributed_sgd_tpu.data.rcv1 import train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.parallel.mesh import make_mesh
+
+
+def _data(seed=50):
+    return train_test_split(rcv1_like(240, n_features=64, nnz=6, seed=seed))
+
+
+def test_loss_checker_persists_best(tmp_path):
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    checker = LossChecker(1.0, checkpointer=ckpt)
+    w1, w2 = np.ones(4, np.float32), np.full(4, 2.0, np.float32)
+    checker.check(0.5, 0.9, w1, step=10)   # best -> saved
+    checker.check(0.9, 0.8, w2, step=20)   # worse -> NOT saved
+    step, state = ckpt.restore_latest()
+    assert step == 10
+    np.testing.assert_array_equal(np.asarray(state["weights"]), w1)
+    assert float(state["best_loss"]) == 0.5
+    ckpt.close()
+
+
+def test_resumed_checker_saves_past_prior_steps(tmp_path):
+    """A resumed run's fresh step counter must not save below (or at) the
+    prior run's snapshots: restore_latest picks the max step, and orbax
+    silently drops writes to an existing step."""
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    LossChecker(1.0, checkpointer=ckpt).check(0.5, 0.9, np.ones(4, np.float32), step=300)
+    ckpt.close()
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    c2 = LossChecker(1.0, checkpointer=ckpt2)
+    w_new = np.full(4, 7.0, np.float32)
+    c2.check(0.4, 0.9, w_new, step=0)  # fresh counter at 0, better loss
+    step, state = ckpt2.restore_latest()
+    assert step == 301  # strictly past the prior run's 300, never equal
+    np.testing.assert_array_equal(np.asarray(state["weights"]), w_new)
+    ckpt2.close()
+
+
+def test_resumed_checker_keeps_prior_best(tmp_path):
+    """best_loss is seeded from the snapshot: a resumed run's first, worse
+    evaluation must NOT overwrite the prior run's true best."""
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    w_best = np.ones(4, np.float32)
+    LossChecker(1.0, checkpointer=ckpt).check(0.2, 0.9, w_best, step=300)
+    ckpt.close()
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    c2 = LossChecker(1.0, checkpointer=ckpt2)
+    assert c2.best_loss == pytest.approx(0.2)
+    c2.check(0.9, 0.5, np.full(4, 9.0, np.float32), step=0)  # worse
+    step, state = ckpt2.restore_latest()
+    assert step == 300  # nothing newer was written
+    np.testing.assert_array_equal(np.asarray(state["weights"]), w_best)
+    np.testing.assert_array_equal(np.asarray(c2.best_weights), w_best)
+    ckpt2.close()
+
+
+def test_sync_trainer_saves_final_state_off_cadence(tmp_path):
+    """checkpoint_every=5 with a 3-epoch fit: the final state must still be
+    persisted at fit end, not lost."""
+    from distributed_sgd_tpu.core.trainer import SyncTrainer
+
+    train, test = _data(seed=52)
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    t = SyncTrainer(model, make_mesh(2), 16, 0.1, checkpointer=ckpt,
+                    checkpoint_every=5)
+    r = t.fit(train, test, max_epochs=3)
+    step, state = ckpt.restore_latest()
+    assert step == 3
+    np.testing.assert_allclose(np.asarray(state["weights"]),
+                               np.asarray(r.state.weights))
+    ckpt.close()
+
+
+def test_local_sgd_kill_and_resume(tmp_path):
+    from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
+
+    train, test = _data()
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    eng = LocalSGDEngine(model, make_mesh(2), batch_size=8, learning_rate=0.1,
+                         sync_period=4, check_every=16, checkpointer=ckpt)
+    res1 = eng.fit(train, test, max_epochs=2)
+    ckpt.close()  # "kill" the process
+
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    restored = ckpt2.restore_latest()
+    assert restored is not None, "no best-weights snapshot was persisted"
+    step, state = restored
+    w_restored = np.asarray(state["weights"])
+    # the persisted snapshot is the fit's best weights
+    np.testing.assert_allclose(w_restored, np.asarray(res1.state.weights))
+
+    eng2 = LocalSGDEngine(model, make_mesh(2), batch_size=8, learning_rate=0.1,
+                          sync_period=4, check_every=16, checkpointer=ckpt2)
+    res2 = eng2.fit(train, test, max_epochs=1, initial_weights=w_restored)
+    ckpt2.close()
+    # resumed run starts warm: its first recorded loss should not be the
+    # cold-start w=0 loss (which is 1.0 + reg for hinge at w=0)
+    assert res2.test_losses, "resumed fit recorded no loss checks"
+    assert res2.test_losses[0] < 1.0
+
+
+def test_hogwild_kill_and_resume(tmp_path):
+    from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
+
+    train, test = _data(seed=51)
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    eng = HogwildEngine(model, n_workers=2, batch_size=8, learning_rate=0.1,
+                        check_every=20, checkpointer=ckpt)
+    res1 = eng.fit(train, test, max_epochs=1)
+    ckpt.close()
+
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    restored = ckpt2.restore_latest()
+    assert restored is not None
+    _step, state = restored
+    np.testing.assert_allclose(np.asarray(state["weights"]),
+                               np.asarray(res1.state.weights))
+    ckpt2.close()
+
+
+def test_config_new_fields_roundtrip(monkeypatch):
+    monkeypatch.setenv("DSGD_ENGINE", "rpc")
+    monkeypatch.setenv("DSGD_CHECKPOINT_EVERY", "3")
+    cfg = Config.from_env()
+    assert cfg.engine == "rpc" and cfg.checkpoint_every == 3
+    cfg2 = Config.from_json(cfg.to_json())
+    assert cfg2 == cfg
+
+
+@pytest.mark.parametrize("field,value", [
+    ("engine", "bogus"), ("model", "bogus"), ("async_mode", "bogus"),
+    ("kernel", "bogus"), ("kernel", "dense"), ("virtual_workers", 0),
+    ("checkpoint_every", 0),
+])
+def test_config_validation_rejects(field, value):
+    with pytest.raises(ValueError):
+        Config(**{field: value})
