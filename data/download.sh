@@ -10,7 +10,6 @@ cd "$(dirname "$0")"
 BASE="http://www.ai.mit.edu/projects/jmlr/papers/volume5/lewis04a"
 
 for f in \
-  a12-token-files/lyrl2004_tokens_train.dat \
   a13-vector-files/lyrl2004_vectors_train.dat \
   a13-vector-files/lyrl2004_vectors_test_pt0.dat \
   a13-vector-files/lyrl2004_vectors_test_pt1.dat \

@@ -65,6 +65,7 @@ class Config:
     pad_width: Optional[int] = None  # sparse-batch nnz padding (None = auto)
     kernel: str = "mxu"  # mxu | scalar | pallas (sync-engine sparse kernels)
     virtual_workers: int = 1  # reference workers emulated per mesh device
+    exact_topology: bool = False  # insist on exactly node_count workers
 
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
@@ -85,6 +86,12 @@ class Config:
             raise ValueError("virtual_workers must be >= 1")
         if self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
+        if self.exact_topology and self.virtual_workers != 1:
+            raise ValueError(
+                "exact_topology and an explicit virtual_workers are mutually "
+                "exclusive: virtual_workers pins the per-device emulation "
+                "directly, so the exact-topology solver would be ignored"
+            )
 
     @property
     def role(self) -> str:
@@ -129,6 +136,7 @@ class Config:
             pad_width=_env("DSGD_PAD_WIDTH", None, int),
             kernel=_env("DSGD_KERNEL", cls.kernel, str),
             virtual_workers=_env("DSGD_VIRTUAL_WORKERS", cls.virtual_workers, int),
+            exact_topology=_env("DSGD_EXACT_TOPOLOGY", cls.exact_topology, bool),
         )
         return dataclasses.replace(cfg, **overrides)
 

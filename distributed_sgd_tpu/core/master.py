@@ -255,8 +255,11 @@ class MasterNode:
         split: SplitFn = vanilla_split,
         timeout_s: float = 60.0,
         retries: int = 1,
-    ) -> np.ndarray:
-        """Fan ForwardRequests out to every worker; gather predictions.
+        return_margins: bool = False,
+    ):
+        """Fan ForwardRequests out to every worker; gather predictions
+        (and, with `return_margins`, the raw x.w margins — exact input for
+        margin-based losses like logistic).
 
         Same fault policy as fit_sync: per-call deadlines, `retries`
         consecutive failures evict the worker, and the fan-out is retried
@@ -275,7 +278,10 @@ class MasterNode:
             for (key, stub), ids in zip(members, parts):
                 try:
                     fut = stub.Forward.future(
-                        pb.ForwardRequest(samples=ids.astype(np.int32), weights=wmsg),
+                        pb.ForwardRequest(
+                            samples=ids.astype(np.int32), weights=wmsg,
+                            want_margins=return_margins,
+                        ),
                         timeout=timeout_s,
                     )
                 except ValueError:
@@ -284,9 +290,17 @@ class MasterNode:
             ok, failed = _await_futures(futs)
             if not failed:
                 out = np.zeros(len(self.train), dtype=np.float32)
+                margins = np.zeros(len(self.train), dtype=np.float32)
                 for ids, (_, reply) in zip(parts, ok):
                     out[ids] = np.fromiter(reply.predictions, dtype=np.float32)
-                return out
+                    if return_margins:
+                        if len(reply.margins) != len(ids):
+                            # version-skew tolerance: an older worker that
+                            # predates the margins field replies without it
+                            margins = None
+                        elif margins is not None:
+                            margins[ids] = np.fromiter(reply.margins, dtype=np.float32)
+                return (out, margins) if return_margins else out
             for key, _ in ok:
                 tracker.record_ok(key)
             for key, code in failed:
@@ -302,16 +316,30 @@ class MasterNode:
     def distributed_loss(self, weights: np.ndarray) -> float:
         """Objective from the Forward fan-out (Master.scala:77-98).
 
-        Reconstructs per-sample loss from PREDICTIONS, like the reference —
-        exact for prediction-based losses (the reference's hinge); use the
-        mesh engines' evaluate() for margin-based losses (logistic etc.).
+        Computes per-sample losses from the workers' MARGINS (requested via
+        ForwardRequest.want_margins) — exact for every model:
+        prediction-based losses (the reference's hinge) are unchanged
+        because losses_from_margins defaults to sample_loss(predict(m)),
+        and margin-based losses (logistic) no longer need the mesh path.
+        If an older worker replies without margins (version skew), falls
+        back to the reference's prediction-based reconstruction — still
+        exact for hinge; raises for margin-only losses.
         """
-        preds = self.predict(weights)
+        preds, margins = self.predict(weights, return_margins=True)
         y = self.train.labels
-        sample = np.asarray(
-            self.model.sample_loss(jnp.asarray(preds), jnp.asarray(y))
-        )
         reg = self.model.lam * float(np.dot(weights, weights))
+        if margins is not None:
+            sample = np.asarray(
+                self.model.losses_from_margins(jnp.asarray(margins), jnp.asarray(y))
+            )
+        else:
+            self.log.warning(
+                "a worker replied without margins (older binary?); "
+                "reconstructing loss from predictions (Master.scala:77-98)"
+            )
+            sample = np.asarray(
+                self.model.sample_loss(jnp.asarray(preds), jnp.asarray(y))
+            )
         return reg + float(sample.mean())
 
     def distributed_accuracy(self, weights: np.ndarray) -> float:
@@ -437,6 +465,7 @@ class MasterNode:
             test_newest_first.insert(0, test_loss)
             self.metrics.histogram("master.sync.loss").record(loss)
             self.metrics.histogram("master.sync.acc").record(100 * acc)
+            self.metrics.histogram("master.sync.epoch.seconds").record(epoch_s)
             self.log.info(
                 "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
                 epoch, loss, acc, test_loss, test_acc, epoch_s,

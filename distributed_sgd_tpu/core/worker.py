@@ -204,14 +204,18 @@ class WorkerNode:
         self.metrics.counter("slave.sync.backward").increment()
         return np.asarray(g)
 
-    def compute_forward(self, w: np.ndarray, ids: np.ndarray) -> np.ndarray:
-        """Forward RPC body (Slave.scala:129-140)."""
+    def compute_forward(self, w: np.ndarray, ids: np.ndarray):
+        """Forward RPC body (Slave.scala:129-140) -> (predictions, margins).
+
+        Margins ride along so the master can compute margin-based losses
+        (logistic) exactly — see ForwardReply in dsgd.proto."""
         pids, _ = self._pad_ids(ids)
         wj = jnp.asarray(w)
         batch = SparseBatch(self._idx[pids], self._val[pids])
-        preds = self.model.forward(wj, batch)
+        margins = self.model.margins(wj, batch)
+        preds = self.model.predict(margins)
         self.metrics.counter("slave.sync.forward").increment()
-        return np.asarray(preds)[: len(ids)]
+        return np.asarray(preds)[: len(ids)], np.asarray(margins)[: len(ids)]
 
     # -- async engine (Slave.scala:79-111,159-195) -------------------------
 
@@ -294,7 +298,9 @@ class _WorkerServicer:
     def Forward(self, request, context):  # noqa: N802
         w = codec.decode_tensor(request.weights)
         ids = np.fromiter(request.samples, dtype=np.int64)
-        preds = self.w.compute_forward(w, ids)
+        preds, margins = self.w.compute_forward(w, ids)
+        if request.want_margins:
+            return pb.ForwardReply(predictions=preds, margins=margins)
         return pb.ForwardReply(predictions=preds)
 
     def Gradient(self, request, context):  # noqa: N802

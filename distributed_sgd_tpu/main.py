@@ -89,31 +89,57 @@ def _restore_weights(ckpt):
     return np.asarray(state["weights"])
 
 
+def select_topology(
+    node_count: int, n_devices: int, use_async: bool,
+    virtual_workers: int = 1, exact_topology: bool = False,
+):
+    """(mesh devices, virtual workers per device) for the sync path.
+
+    Cover the full reference worker count even on fewer chips — remaining
+    workers are emulated per device (parallel/sync.py virtual_workers).
+    Default: use ALL available devices with ceil-division virtual workers —
+    the total may exceed node_count by < n_devices, but no device sits
+    idle.  exact_topology=True (DSGD_EXACT_TOPOLOGY) instead insists on
+    exactly node_count workers via the largest divisor <= n_devices (which
+    can idle most of the mesh — e.g. node_count=7 on 6 chips runs 1 chip).
+    Async engines ignore virtual_workers, so they always get every device.
+    """
+    n_max = min(node_count, n_devices)
+    virtual = virtual_workers
+    if not use_async and virtual == 1 and node_count > n_max:
+        if exact_topology:
+            n = max(d for d in range(1, n_max + 1) if node_count % d == 0)
+            virtual = node_count // n
+            if n < n_max:
+                log.warning(
+                    "exact_topology: node_count=%d is not divisible by any "
+                    "device count <= %d; running the exact %d-worker "
+                    "topology on %d device(s) (%d idle)",
+                    node_count, n_max, node_count, n, n_max - n,
+                )
+        else:
+            n = n_max
+            virtual = -(-node_count // n)  # ceil
+            if n * virtual != node_count:
+                log.warning(
+                    "node_count=%d rounded up to %d workers (%d devices x %d "
+                    "virtual) to keep every device busy; set "
+                    "DSGD_EXACT_TOPOLOGY=1 for exactly node_count workers",
+                    node_count, n * virtual, n, virtual,
+                )
+    else:
+        n = n_max
+    return n, virtual
+
+
 def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     """Dev-mode fast path: in-mesh engines, no RPC data plane."""
     from distributed_sgd_tpu.parallel.mesh import make_mesh
 
-    # SYNC path: cover the full reference worker count even on fewer chips —
-    # remaining workers are emulated per device (parallel/sync.py
-    # virtual_workers).  Keep the total EXACTLY node_count: use the largest
-    # device count that divides it, so mesh_workers * virtual == node_count.
-    # Async engines ignore virtual_workers, so they always get the full
-    # device mesh (n_max) instead of the divisor-shrunk one.
-    n_max = min(cfg.node_count, len(jax.devices()))
-    virtual = cfg.virtual_workers
-    if not cfg.use_async and virtual == 1 and cfg.node_count > n_max:
-        n = max(d for d in range(1, n_max + 1) if cfg.node_count % d == 0)
-        virtual = cfg.node_count // n
-        if n < n_max:
-            log.warning(
-                "node_count=%d is not divisible by any device count <= %d; "
-                "running the exact %d-worker topology on %d device(s) "
-                "(%d idle) — pick a node_count divisible by the device "
-                "count for full throughput",
-                cfg.node_count, n_max, cfg.node_count, n, n_max - n,
-            )
-    else:
-        n = n_max
+    n, virtual = select_topology(
+        cfg.node_count, len(jax.devices()), cfg.use_async,
+        cfg.virtual_workers, cfg.exact_topology,
+    )
     mesh = make_mesh(n)
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
     log.info(

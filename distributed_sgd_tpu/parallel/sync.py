@@ -118,11 +118,13 @@ class BoundSync:
         self.sampling = sampling
         self.n_workers = mesh.shape[AXIS]
         # Emulate K reference workers per mesh device: each step draws K
-        # per-worker batches, computes each worker's sum+regularize reply
-        # exactly (vmap), and means them — reference topology semantics
-        # (Slave.scala:142-157 per worker + Master.scala:194 mean) without
-        # needing K physical chips.  Total worker count = mesh * K; the
-        # reference's application.conf nodeCount=3 maps to K=3 on one chip.
+        # per-worker batches from K DISJOINT contiguous sub-shards (the
+        # vanilla-split assignment, SplitStrategy.scala:13-14), computes
+        # each worker's sum+regularize reply exactly (vmap), and means
+        # them — reference topology semantics (Slave.scala:142-157 per
+        # worker + Master.scala:194 mean) without needing K physical
+        # chips.  Total worker count = mesh * K; the reference's
+        # application.conf nodeCount=3 maps to K=3 on one chip.
         self.virtual_workers = int(virtual_workers)
         if self.virtual_workers < 1:
             raise ValueError("virtual_workers must be >= 1")
@@ -184,10 +186,22 @@ class BoundSync:
         k, b = self.virtual_workers, self.batch_size
         if self.sampling == "fresh":
             # fresh uniform draw per step, like the per-batch reshuffle in
-            # Master.scala:184 (delta: with replacement within a batch)
-            return jax.random.randint(
-                jax.random.fold_in(key, step), (k, b), 0, self.shard_n
+            # Master.scala:184 (delta: with replacement within a batch).
+            # Each virtual worker draws from its own DISJOINT contiguous
+            # sub-shard of CEIL size — exactly the vanilla-split assignment
+            # (SplitStrategy.scala:13-14: grouped(ceil(n/k))), so the
+            # K-virtual and K-device topologies partition data identically
+            # and every sample is reachable.  The short final sub-shard
+            # maps draws in via modulo (bias bounded by 1/size; sampling
+            # here is already with-replacement)
+            sub = -(-self.shard_n // k)  # ceil
+            starts = np.minimum(np.arange(k) * sub, self.shard_n - 1)
+            sizes = np.maximum(self.shard_n - starts, 1)
+            base = jax.random.randint(
+                jax.random.fold_in(key, step), (k, b), 0, sub
             )
+            base = base % jnp.asarray(np.minimum(sub, sizes))[:, None]
+            return base + jnp.asarray(starts, dtype=base.dtype)[:, None]
         # 'epoch': walk a per-epoch permutation in contiguous slices
         perm = jax.random.permutation(key, self.shard_n)
         start = jnp.minimum(step * k * b, self.shard_n - k * b)
@@ -349,6 +363,11 @@ class BoundSync:
                 f"sampling='epoch' needs virtual_workers*batch_size "
                 f"({self.virtual_workers}*{self.batch_size}) <= per-device shard "
                 f"({self.shard_n}); lower the batch size or worker count"
+            )
+        if self.virtual_workers > self.shard_n:
+            raise ValueError(
+                f"virtual_workers ({self.virtual_workers}) > per-device shard "
+                f"({self.shard_n}): each virtual worker needs a nonempty sub-shard"
             )
 
     # -- host API ----------------------------------------------------------

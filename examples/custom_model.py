@@ -1,9 +1,8 @@
 """Defining a custom linear model: subclass LinearModel with a margin-based
 coefficient rule and it runs on the mesh engines with every kernel backend
-(the whole batched backward stays one gather + elementwise + scatter).
-Margin-based losses like this one are a mesh-engine feature: the RPC-mode
-master's distributed_loss reconstructs loss from predictions only
-(reference parity, hinge-style losses).
+(the whole batched backward stays one gather + elementwise + scatter) AND
+over the RPC topology — ForwardReply carries raw margins, so the RPC
+master's distributed_loss is exact for margin-based losses too.
 
 This example adds a squared-hinge SVM (smooth variant, not in the
 reference) and trains it with the sync engine.

@@ -155,3 +155,18 @@ def test_config_new_fields_roundtrip(monkeypatch):
 def test_config_validation_rejects(field, value):
     with pytest.raises(ValueError):
         Config(**{field: value})
+
+
+@pytest.mark.parametrize("node_count,n_dev,use_async,exact,want", [
+    (7, 6, False, False, (6, 2)),   # near-divisor: all devices, ceil virtual
+    (7, 6, False, True, (1, 7)),    # exact: largest divisor of 7 <= 6 is 1
+    (8, 6, False, False, (6, 2)),   # 6x2=12 >= 8, no idle devices
+    (8, 6, False, True, (4, 2)),    # exact: 4 devices x 2 = 8
+    (3, 8, False, False, (3, 1)),   # fewer workers than devices
+    (7, 6, True, False, (6, 1)),    # async always gets every device
+])
+def test_select_topology(node_count, n_dev, use_async, exact, want):
+    from distributed_sgd_tpu.main import select_topology
+
+    assert select_topology(node_count, n_dev, use_async,
+                           exact_topology=exact) == want

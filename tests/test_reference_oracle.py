@@ -76,11 +76,13 @@ def test_engine_matches_boxed_map_oracle(kernel):
     key = jax.random.PRNGKey(21)
     got = np.asarray(bound.step(jnp.asarray(w_np), key))
 
-    # replicate the engine's sampling stream, then run the boxed-map oracle
+    # replicate the engine's sampling stream (disjoint per-virtual-worker
+    # sub-shards), then run the boxed-map oracle
     key2 = jax.random.fold_in(key, 0)  # axis_index 0 on the 1-device mesh
+    sub = bound.shard_n // K
     ids = np.asarray(
-        jax.random.randint(jax.random.fold_in(key2, 0), (K, B), 0, bound.shard_n)
-    )
+        jax.random.randint(jax.random.fold_in(key2, 0), (K, B), 0, sub)
+    ) + (np.arange(K) * sub)[:, None]
     w0 = {i: float(w_np[i]) for i in range(D) if w_np[i] != 0.0}
     w1 = oracle_step(w0, rows, ys, [list(ids[k]) for k in range(K)], ds_map)
     want = np.zeros(D, dtype=np.float64)

@@ -32,11 +32,14 @@ def test_one_step_matches_manual_per_worker_math():
     key = jax.random.PRNGKey(11)
     got = np.asarray(bound.step(w0, key))
 
-    # manual oracle on the dense/scalar path, replicating the engine's RNG
+    # manual oracle on the dense/scalar path, replicating the engine's RNG:
+    # each virtual worker draws from its own disjoint contiguous sub-shard
     key2 = jax.random.fold_in(key, 0)  # axis_index == 0 on the 1-device mesh
+    sub = bound.shard_n // k
     ids = np.asarray(
-        jax.random.randint(jax.random.fold_in(key2, 0), (k, b), 0, bound.shard_n)
-    )
+        jax.random.randint(jax.random.fold_in(key2, 0), (k, b), 0, sub)
+    ) + (np.arange(k) * sub)[:, None]
+    assert all(set(ids[wk]) <= set(range(wk * sub, (wk + 1) * sub)) for wk in range(k))
     idx, val, y = np.asarray(data.indices), np.asarray(data.values), np.asarray(data.labels)
     gs = []
     for wk in range(k):
@@ -63,6 +66,30 @@ def test_virtual_workers_epoch_runs_and_converges_direction():
         w = bound.epoch(w, jax.random.fold_in(key, e))
     loss1, _ = bound.evaluate(w)
     assert np.isfinite(loss1) and loss1 < loss0
+
+
+def test_fresh_subshards_cover_all_samples_nondivisible():
+    """shard_n=10, k=3: ceil sub-shards [0,4),[4,8),[8,10) — every sample
+    reachable (the vanilla-split partition), ids always in range."""
+    d, k, b = 64, 3, 8
+    data = rcv1_like(10, n_features=d, nnz=4, seed=9)
+    model = _model(d, seed=9)
+    eng = SyncEngine(model, make_mesh(1), batch_size=b, learning_rate=0.1,
+                     virtual_workers=k, eval_chunk=2)
+    bound = eng.bind(data)
+    assert bound.shard_n == 10
+    key = jax.random.PRNGKey(0)
+    seen = set()
+    for step in range(40):
+        ids = np.asarray(bound._sample_ids(jax.random.fold_in(key, 0), step))
+        assert ids.shape == (k, b)
+        sub = -(-10 // k)  # 4
+        for wk in range(k):
+            lo = min(wk * sub, 9)
+            hi = min(lo + sub, 10)
+            assert ids[wk].min() >= lo and ids[wk].max() < hi
+        seen.update(ids.ravel().tolist())
+    assert seen == set(range(10))  # no sample is unreachable
 
 
 def test_epoch_sampling_with_virtual_workers():
