@@ -63,7 +63,7 @@ class Config:
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     profile_dir: Optional[str] = None  # jax.profiler trace output
     pad_width: Optional[int] = None  # sparse-batch nnz padding (None = auto)
-    kernel: str = "mxu"  # mxu | scalar | pallas (sync-engine sparse kernels)
+    kernel: str = "mxu"  # mxu | scalar (sync-engine sparse kernels)
     virtual_workers: int = 1  # reference workers emulated per mesh device
     exact_topology: bool = False  # insist on exactly node_count workers
 
@@ -71,8 +71,12 @@ class Config:
         "model": ("hinge", "svm", "logistic", "least_squares"),
         "engine": ("mesh", "rpc"),
         "async_mode": ("gossip", "local_sgd"),
-        # 'dense' is auto-selected from the data layout, never configured
-        "kernel": ("mxu", "scalar", "pallas"),
+        # 'dense' is auto-selected from the data layout, never configured;
+        # 'pallas' is an experiment demoted from the config surface — it
+        # measured slower than 'mxu' at every swept shape and VMEM-OOMs at
+        # large batches (benches/pallas_sweep.py; BASELINE.md) — but stays
+        # reachable through SyncEngine(kernel='pallas') for kernel work
+        "kernel": ("mxu", "scalar"),
     }
 
     def __post_init__(self):

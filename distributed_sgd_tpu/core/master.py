@@ -536,7 +536,10 @@ class MasterNode:
                 continue
             raw_loss, raw_acc = self.local_loss(w_now, test=True)
             stop = checker.check(raw_loss, raw_acc, w_now, step=updates)
+            # counter keeps the reference's toLong truncation quirk
+            # (MasterAsync.scala:126); the histogram carries the real value
             self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
+            self.metrics.histogram("master.async.loss.value").record(checker.smoothed[0])
             self.log.info(
                 "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
                 updates, checker.smoothed[0], checker.smoothed_accs[0],

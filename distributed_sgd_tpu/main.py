@@ -112,10 +112,10 @@ def select_topology(
             virtual = node_count // n
             if n < n_max:
                 log.warning(
-                    "exact_topology: node_count=%d is not divisible by any "
-                    "device count <= %d; running the exact %d-worker "
-                    "topology on %d device(s) (%d idle)",
-                    node_count, n_max, node_count, n, n_max - n,
+                    "exact_topology: shrank the mesh to %d device(s) (the "
+                    "largest divisor of node_count=%d that is <= %d; %d "
+                    "device(s) idle) to run exactly %d workers",
+                    n, node_count, n_max, n_max - n, node_count,
                 )
         else:
             n = n_max
@@ -161,15 +161,11 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     elif cfg.use_async:
         from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
 
-        kernel = cfg.kernel
-        if kernel == "pallas":
-            log.warning("local_sgd does not support kernel=pallas; using mxu")
-            kernel = "mxu"
         eng = LocalSGDEngine(
             model, mesh, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, sync_period=cfg.sync_period,
             check_every=cfg.check_every, leaky_loss=cfg.leaky_loss, seed=cfg.seed,
-            kernel=kernel, checkpointer=ckpt,
+            kernel=cfg.kernel, checkpointer=ckpt,
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion,
                       initial_weights=_restore_weights(ckpt))

@@ -339,7 +339,11 @@ class HogwildEngine:
                     continue
                 raw_loss, raw_acc = eval_bound.evaluate(w_now)
                 stop = checker.check(raw_loss, raw_acc, w_now, step=updates)
+                # counter with the reference's toLong truncation quirk
+                # (MasterAsync.scala:126) + a real-valued histogram for
+                # dashboards (int() flatlines any loss < 1)
                 self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
+                self.metrics.histogram("master.async.loss.value").record(checker.smoothed[0])
                 log.info(
                     "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
                     updates, checker.smoothed[0], checker.smoothed_accs[0],

@@ -17,19 +17,23 @@ The whole epoch is ONE compiled program: no host round-trips, no
 serialization of the 47k-dim weight vector per batch per worker (the
 reference ships it over gRPC every batch, Master.scala:184-189).
 
-Three kernel backends (`kernel=`): 'mxu' (default) keeps weights in the
+Kernel backends (`kernel=`): 'mxu' (default) keeps weights in the
 lane-blocked [R, 128] view across the epoch scan and runs the sparse
 gather/scatter as one-hot MXU matmuls (ops/mxu.py — ~32 us vs ~310 us per
-3-worker step at RCV1 shapes on v5e, benches/step_bench.py); 'pallas' is
-the hand-fused single-launch version of the same formulation
-(ops/pallas_sparse.py — ~109 us at the same config: beats scalar 3x but
-trails XLA's fusion of the big-matmul form; kept as a first-class backend
-and the starting point for shapes where manual fusion wins); 'scalar' is
+3-worker step at RCV1 shapes on v5e, benches/step_bench.py); 'scalar' is
 the reference-shaped take/scatter path (ops/sparse.py); 'dense' runs
 dense-layout datasets (Dataset.dense — no index array) as plain [B, D]
-matmuls, auto-selected at bind().  All produce
-identical updates up to float summation order (tests/test_mxu_kernels.py,
-tests/test_pallas_kernels.py, tests/test_dense_path.py).
+matmuls, auto-selected at bind().  'pallas' — the hand-fused single-launch
+version of the one-hot formulation (ops/pallas_sparse.py) — is an
+EXPERIMENT, not offered via Config: the regime sweep
+(benches/pallas_sweep.py, v5e) measured it 1.5-4.3x slower than 'mxu' at
+every shape tried (D in {4k, 47k}, B in {100, 1024}, K in {1, 3}) and it
+VMEM-OOMs once the flat per-worker batch outgrows VMEM (B=1024, K=3
+needed 162M of 128M) because its inputs are VMEM-resident by
+construction; XLA's own fusion of the same matmuls pipelines HBM better.
+All backends produce identical updates up to float summation order
+(tests/test_mxu_kernels.py, tests/test_pallas_kernels.py,
+tests/test_dense_path.py).
 
 Batch sampling mirrors Master.scala:184 (`split.map(Random.shuffle(_))`
 then slice): every step each worker draws a fresh uniform batch from its
@@ -364,10 +368,17 @@ class BoundSync:
                 f"({self.virtual_workers}*{self.batch_size}) <= per-device shard "
                 f"({self.shard_n}); lower the batch size or worker count"
             )
-        if self.virtual_workers > self.shard_n:
+        k = self.virtual_workers
+        sub = -(-self.shard_n // k)
+        if k > 1 and (k - 1) * sub >= self.shard_n:
+            # vanilla_split would hand the trailing worker(s) an EMPTY
+            # group here (grouped(ceil) yields < k groups); rather than
+            # silently double-weighting the last sample, refuse
             raise ValueError(
-                f"virtual_workers ({self.virtual_workers}) > per-device shard "
-                f"({self.shard_n}): each virtual worker needs a nonempty sub-shard"
+                f"virtual_workers={k} over a {self.shard_n}-sample shard "
+                f"leaves trailing workers without a nonempty ceil-split "
+                f"sub-shard (the reference's vanilla split would give them "
+                f"empty groups); lower virtual_workers"
             )
 
     # -- host API ----------------------------------------------------------

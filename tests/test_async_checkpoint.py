@@ -149,8 +149,8 @@ def test_config_new_fields_roundtrip(monkeypatch):
 
 @pytest.mark.parametrize("field,value", [
     ("engine", "bogus"), ("model", "bogus"), ("async_mode", "bogus"),
-    ("kernel", "bogus"), ("kernel", "dense"), ("virtual_workers", 0),
-    ("checkpoint_every", 0),
+    ("kernel", "bogus"), ("kernel", "dense"), ("kernel", "pallas"),
+    ("virtual_workers", 0), ("checkpoint_every", 0),
 ])
 def test_config_validation_rejects(field, value):
     with pytest.raises(ValueError):

@@ -92,6 +92,22 @@ def test_fresh_subshards_cover_all_samples_nondivisible():
     assert seen == set(range(10))  # no sample is unreachable
 
 
+def test_degenerate_subshard_config_rejected():
+    """shard_n=5, k=4: ceil-split gives the trailing worker an empty group
+    (reference vanilla_split semantics) — refuse instead of silently
+    double-weighting the last sample."""
+    import pytest
+
+    d = 64
+    data = rcv1_like(5, n_features=d, nnz=4, seed=11)
+    model = _model(d, seed=11)
+    eng = SyncEngine(model, make_mesh(1), batch_size=1, learning_rate=0.1,
+                     virtual_workers=4, eval_chunk=1)
+    bound = eng.bind(data)
+    with pytest.raises(ValueError, match="empty groups"):
+        bound.step(jnp.zeros(d, jnp.float32), jax.random.PRNGKey(0))
+
+
 def test_epoch_sampling_with_virtual_workers():
     d = 200
     data = rcv1_like(96, n_features=d, nnz=6, seed=6)
