@@ -69,8 +69,9 @@ def main():
     ap.add_argument("--ks", type=str, default="1,3")
     args = ap.parse_args()
 
-    import jax  # noqa: F401  (force backend init before timing)
-    np.asarray(__import__("jax.numpy", fromlist=["zeros"]).zeros(4))
+    import jax.numpy as jnp
+
+    np.asarray(jnp.zeros(4))  # force backend init before timing
 
     Ds = [int(x) for x in args.ds.split(",")]
     Bs = [int(x) for x in args.bs.split(",")]
@@ -86,7 +87,9 @@ def main():
                     try:
                         us = round(time_step(D, B, K, kernel), 1)
                     except Exception as e:  # e.g. pallas VMEM OOM at large B*K
-                        us = "OOM" if "emory" in str(e) else f"error: {type(e).__name__}"
+                        msg = str(e).lower()
+                        oom = any(s in msg for s in ("memory", "vmem", "resource_exhausted"))
+                        us = "OOM" if oom else f"error: {type(e).__name__}"
                     row[kernel + "_us"] = us
                     row[kernel + "_wall_s"] = round(time.perf_counter() - t0, 1)
                 if isinstance(row["pallas_us"], float) and isinstance(row["mxu_us"], float):

@@ -18,6 +18,7 @@ from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from distributed_sgd_tpu.core.early_stopping import Criterion
 from distributed_sgd_tpu.core.grad_state import GradState
@@ -98,6 +99,12 @@ class SyncTrainer:
             if restored is not None:
                 start_epoch, state = restored
                 w = jnp.asarray(state["weights"])
+                # early-stopping continuity: the criterion sees the full
+                # newest-first test-loss history, not just post-resume epochs
+                if "test_losses_nf" in state:
+                    test_losses_newest_first = [
+                        float(x) for x in np.asarray(state["test_losses_nf"])
+                    ]
                 log.info("resumed from checkpoint at epoch %d", start_epoch)
 
         # prefer the second epoch (steady-state, compile excluded) but fall
@@ -140,7 +147,8 @@ class SyncTrainer:
             )
 
             if self.checkpointer is not None and (epoch + 1) % self.checkpoint_every == 0:
-                self.checkpointer.save(epoch + 1, w)
+                self.checkpointer.save(epoch + 1, w, extra=self._ckpt_extra(
+                    test_losses_newest_first))
 
             if criterion is not None and criterion(test_losses_newest_first):
                 log.info("Converged to target: stopping computation")
@@ -156,7 +164,8 @@ class SyncTrainer:
             and result.epochs_run > start_epoch
             and result.epochs_run % self.checkpoint_every != 0
         ):
-            self.checkpointer.save(result.epochs_run, w)
+            self.checkpointer.save(result.epochs_run, w, extra=self._ckpt_extra(
+                test_losses_newest_first))
         if self.profile_dir is not None and not profiled:
             log.warning(
                 "no profiler trace captured: the fit stopped before epoch %d",
@@ -167,6 +176,12 @@ class SyncTrainer:
             weights=w, loss=result.losses[-1] if result.losses else float("nan")
         ).finish()
         return result
+
+    @staticmethod
+    def _ckpt_extra(test_losses_newest_first: List[float]):
+        if not test_losses_newest_first:
+            return None
+        return {"test_losses_nf": np.asarray(test_losses_newest_first, np.float32)}
 
     def predict(self, weights: jax.Array, data: Dataset):
         """Predictions over a split (Master.predict, Master.scala:61-75)."""

@@ -370,7 +370,7 @@ class BoundSync:
             )
         k = self.virtual_workers
         sub = -(-self.shard_n // k)
-        if k > 1 and (k - 1) * sub >= self.shard_n:
+        if self.sampling == "fresh" and k > 1 and (k - 1) * sub >= self.shard_n:
             # vanilla_split would hand the trailing worker(s) an EMPTY
             # group here (grouped(ceil) yields < k groups); rather than
             # silently double-weighting the last sample, refuse

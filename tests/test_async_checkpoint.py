@@ -71,6 +71,32 @@ def test_resumed_checker_keeps_prior_best(tmp_path):
     ckpt2.close()
 
 
+def test_sync_trainer_resume_continues_early_stop_history(tmp_path):
+    """The early-stopping criterion on a resumed fit must see the prior
+    run's test-loss history, not start from scratch."""
+    from distributed_sgd_tpu.core.trainer import SyncTrainer
+
+    train, test = _data(seed=53)
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    t1 = SyncTrainer(model, make_mesh(2), 16, 0.1, checkpointer=ckpt)
+    t1.fit(train, test, max_epochs=3)
+    _step, state = ckpt.restore_latest()
+    assert len(np.asarray(state["test_losses_nf"])) == 3
+    ckpt.close()
+
+    # resume with a criterion that needs >=4 history entries to fire:
+    # only with restored history can one more epoch trigger it
+    def needs_four(newest_first):
+        return len(newest_first) >= 4
+
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    t2 = SyncTrainer(model, make_mesh(2), 16, 0.1, checkpointer=ckpt2)
+    r2 = t2.fit(train, test, max_epochs=10, criterion=needs_four)
+    ckpt2.close()
+    assert r2.epochs_run == 4  # stopped after ONE post-resume epoch
+
+
 def test_sync_trainer_saves_final_state_off_cadence(tmp_path):
     """checkpoint_every=5 with a 3-epoch fit: the final state must still be
     persisted at fit end, not lost."""
