@@ -52,6 +52,17 @@ class LossChecker:
                 if "best_loss" in state:
                     self.best_loss = float(state["best_loss"])
                     self.best_weights = np.asarray(state["weights"])
+                # continuity of the smoothing + stopping history: a resumed
+                # run's leaky smoothing chains from the prior run's values
+                # and its criterion sees the full newest-first series, not
+                # a fresh patience window (same fix as SyncTrainer's
+                # test_losses_nf for the sync path)
+                if "smoothed_nf" in state:
+                    self.smoothed = [float(x) for x in np.asarray(state["smoothed_nf"])]
+                if "smoothed_accs_nf" in state:
+                    self.smoothed_accs = [
+                        float(x) for x in np.asarray(state["smoothed_accs_nf"])
+                    ]
 
     def check(self, raw_loss: float, raw_acc: float, weights, step: Optional[int] = None) -> bool:
         """Record one evaluation; returns True if training should stop.
@@ -71,7 +82,11 @@ class LossChecker:
                 self.checkpointer.save(
                     self._step_base + (step if step is not None else len(self.smoothed)),
                     self.best_weights,
-                    extra={"best_loss": loss},
+                    extra={
+                        "best_loss": loss,
+                        "smoothed_nf": np.asarray(self.smoothed, np.float32),
+                        "smoothed_accs_nf": np.asarray(self.smoothed_accs, np.float32),
+                    },
                 )
         return self.criterion is not None and self.criterion(self.smoothed)
 

@@ -1,0 +1,50 @@
+"""Dense-layout training: least-squares regression on fully-dense rows.
+
+`Dataset.dense` stores values[N, D] only — no index array — and every
+engine routes it through plain-matmul kernels (models/linear.py dense fast
+path), the shape the MXU was built for.  BASELINE.md config 5 measures
+this at 0.043 s/epoch for 1M x 1024 on one v5e chip.
+
+    python examples/train_dense.py [n_samples]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from distributed_sgd_tpu.data.rcv1 import Dataset, train_test_split  # noqa: E402
+from distributed_sgd_tpu.models.linear import make_model  # noqa: E402
+from distributed_sgd_tpu.parallel.mesh import make_mesh  # noqa: E402
+from distributed_sgd_tpu.parallel.sync import SyncEngine  # noqa: E402
+
+
+def main(n: int = 20_000, d: int = 256, epochs: int = 3) -> float:
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype(np.float32) / np.sqrt(d)  # unit-ish rows
+    w_true = rng.normal(size=d).astype(np.float32)
+    y = (x @ w_true + 0.01 * rng.normal(size=n)).astype(np.float32)
+    data = Dataset.dense(x, y)
+    assert data.is_dense
+
+    train, test = train_test_split(data)
+    model = make_model("least_squares", 0.0, d, regularizer="none")
+    eng = SyncEngine(model, make_mesh(1), batch_size=256, learning_rate=0.05)
+    bound, bound_test = eng.bind(train), eng.bind(test)
+    assert bound.kernel == "dense"  # auto-selected from the layout
+
+    w = jnp.zeros(d, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    for e in range(epochs):
+        w = bound.epoch(w, jax.random.fold_in(key, e))
+        mse, _ = bound_test.evaluate(w)
+        print(f"epoch {e}: test_mse={mse:.6f}")
+    return mse
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 20_000)

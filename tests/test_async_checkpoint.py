@@ -53,6 +53,26 @@ def test_resumed_checker_saves_past_prior_steps(tmp_path):
     ckpt2.close()
 
 
+def test_resumed_checker_restores_smoothing_history(tmp_path):
+    """A resumed LossChecker chains its leaky smoothing from the prior
+    run's values and its criterion sees the full history."""
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    c1 = LossChecker(0.5, checkpointer=ckpt)
+    c1.check(0.8, 0.5, np.ones(4, np.float32), step=1)
+    c1.check(0.4, 0.6, np.ones(4, np.float32), step=2)  # smoothed: 0.6, 0.8
+    ckpt.close()
+
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    c2 = LossChecker(0.5, checkpointer=ckpt2)
+    assert c2.smoothed == [pytest.approx(0.6), pytest.approx(0.8)]
+    assert c2.smoothed_accs == [pytest.approx(0.55), pytest.approx(0.5)]
+    c2.check(0.2, 0.7, np.ones(4, np.float32), step=1)
+    # leaky smoothing chained from the restored 0.6, not re-seeded from raw
+    assert c2.smoothed[0] == pytest.approx(0.5 * 0.2 + 0.5 * 0.6)
+    assert len(c2.smoothed) == 3
+    ckpt2.close()
+
+
 def test_resumed_checker_keeps_prior_best(tmp_path):
     """best_loss is seeded from the snapshot: a resumed run's first, worse
     evaluation must NOT overwrite the prior run's true best."""

@@ -23,3 +23,10 @@ def test_async_hogwild_example():
 
     loss = main(n=600)
     assert np.isfinite(loss)
+
+
+def test_dense_example():
+    from examples.train_dense import main
+
+    mse = main(n=800, d=32, epochs=2)
+    assert np.isfinite(mse) and mse < 1.0

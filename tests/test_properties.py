@@ -1,0 +1,104 @@
+"""Property-based invariants (hypothesis) for the wire codecs, the CSR
+packer, and the blocked-layout transforms — the seams where a shape or
+rounding bug would silently corrupt data rather than crash."""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from distributed_sgd_tpu.rpc import codec
+
+
+@st.composite
+def f32_vectors(draw, max_len=512):
+    n = draw(st.integers(1, max_len))
+    # values include zeros so encode_grad exercises both wire forms
+    vals = draw(st.lists(
+        st.one_of(st.just(0.0), st.floats(-1e6, 1e6, width=32)),
+        min_size=n, max_size=n,
+    ))
+    return np.asarray(vals, dtype=np.float32)
+
+
+@given(f32_vectors())
+@settings(max_examples=60, deadline=None)
+def test_tensor_codec_roundtrip(x):
+    np.testing.assert_array_equal(codec.decode_tensor(codec.encode_tensor(x)), x)
+
+
+@given(f32_vectors(), st.floats(0.0, 1.0))
+@settings(max_examples=60, deadline=None)
+def test_grad_codec_roundtrip_any_threshold(x, thresh):
+    """Whatever wire form encode_grad picks, decode restores x exactly."""
+    msg = codec.encode_grad(x, sparse_threshold=thresh)
+    np.testing.assert_array_equal(codec.decode_grad(msg), x)
+
+
+@st.composite
+def csr_inputs(draw):
+    n_rows = draw(st.integers(1, 8))
+    n_features = draw(st.integers(4, 64))
+    nnzs = [draw(st.integers(0, min(6, n_features))) for _ in range(n_rows)]
+    row_ptr = np.zeros(n_rows + 1, dtype=np.int64)
+    np.cumsum(nnzs, out=row_ptr[1:])
+    cols, vals = [], []
+    for nnz in nnzs:
+        ids = draw(st.permutations(range(n_features)))[:nnz]
+        cols.extend(sorted(ids))
+        vals.extend(
+            draw(st.lists(st.floats(0.125, 10.0, width=32), min_size=nnz, max_size=nnz))
+        )
+    return (
+        row_ptr,
+        np.asarray(cols, dtype=np.int32),
+        np.asarray(vals, dtype=np.float32),
+        n_features,
+    )
+
+
+@given(csr_inputs())
+@settings(max_examples=60, deadline=None)
+def test_pack_csr_lossless_at_auto_width(inp):
+    """With auto pad width, packing is lossless: each row's (index, value)
+    multiset is preserved and pads are (0, 0.0)."""
+    from distributed_sgd_tpu.data.rcv1 import pack_csr
+
+    row_ptr, cols, vals, _nf = inp
+    idx, val = pack_csr(row_ptr, cols, vals)
+    assert idx.shape[1] >= 1  # zero-width is reserved for the dense layout
+    for r in range(len(row_ptr) - 1):
+        s, e = row_ptr[r], row_ptr[r + 1]
+        want = sorted(zip(cols[s:e].tolist(), vals[s:e].tolist()))
+        got = [
+            (int(i), float(v))
+            for i, v in zip(idx[r], val[r])
+            if v != 0.0
+        ]
+        assert sorted(got) == want
+
+
+@given(st.integers(1, 4000), st.integers(0, 3))
+@settings(max_examples=40, deadline=None)
+def test_blocked_roundtrip(n_features, seed):
+    import jax.numpy as jnp
+
+    from distributed_sgd_tpu.ops import mxu
+
+    w = np.random.default_rng(seed).normal(size=n_features).astype(np.float32)
+    w2 = mxu.to_blocked(jnp.asarray(w), n_features)
+    assert w2.shape[0] % 8 == 0 and w2.shape[1] == 128
+    back = np.asarray(mxu.from_blocked(w2, n_features))
+    np.testing.assert_array_equal(back, w)
+
+
+@given(st.integers(1, 200), st.integers(1, 6), st.integers(0, 5))
+@settings(max_examples=40, deadline=None)
+def test_padded_layout_covers_and_divides(n_true, n_workers, chunk_exp):
+    from distributed_sgd_tpu.parallel.sync import padded_layout
+
+    eval_chunk = 2 ** chunk_exp
+    total, chunk = padded_layout(n_true, n_workers, eval_chunk)
+    assert total >= n_true
+    assert total % n_workers == 0
+    shard = total // n_workers
+    assert shard % chunk == 0
+    assert chunk <= eval_chunk
