@@ -5,13 +5,16 @@ its only recovery mechanism is the async master's in-memory best-weights
 tracking (MasterAsync.scala:66-69,130-139; SURVEY.md §5.4).  Wiring
 (`Config.checkpoint_dir`, built in main.py):
 
-- SyncTrainer saves weights every `checkpoint_every` epochs and resumes
-  from the latest snapshot (continuing the same batch-sampling stream);
+- SyncTrainer saves weights (plus optimizer state and the newest-first
+  test-loss history) every `checkpoint_every` epochs and resumes from the
+  latest snapshot, continuing the same batch-sampling stream, momentum
+  buffers, and early-stopping window;
 - the async drivers (Hogwild gossip, local-SGD, gRPC MasterNode.fit_async)
-  hand their Checkpointer to LossChecker, which persists each NEW
-  best-weights snapshot — so the reference's "return best" behavior
-  survives a process kill — and main.py feeds the latest snapshot back as
-  `initial_weights` on restart.
+  hand their Checkpointer to LossChecker, which persists the best-so-far
+  weights + full smoothing history on every improvement and every
+  `save_every`-th plateau check — so the reference's "return best"
+  behavior survives a process kill — and main.py feeds the latest snapshot
+  back as `initial_weights` on restart.
 """
 
 from __future__ import annotations

@@ -66,6 +66,8 @@ class Config:
     kernel: str = "mxu"  # mxu | scalar (sync-engine sparse kernels)
     virtual_workers: int = 1  # reference workers emulated per mesh device
     exact_topology: bool = False  # insist on exactly node_count workers
+    optimizer: str = "sgd"  # sgd (reference) | momentum | adam (sync engine)
+    momentum: float = 0.9  # used by optimizer='momentum'
 
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
@@ -77,6 +79,7 @@ class Config:
         # large batches (benches/pallas_sweep.py; BASELINE.md) — but stays
         # reachable through SyncEngine(kernel='pallas') for kernel work
         "kernel": ("mxu", "scalar"),
+        "optimizer": ("sgd", "momentum", "adam"),
     }
 
     def __post_init__(self):
@@ -141,6 +144,8 @@ class Config:
             kernel=_env("DSGD_KERNEL", cls.kernel, str),
             virtual_workers=_env("DSGD_VIRTUAL_WORKERS", cls.virtual_workers, int),
             exact_topology=_env("DSGD_EXACT_TOPOLOGY", cls.exact_topology, bool),
+            optimizer=_env("DSGD_OPTIMIZER", cls.optimizer, str),
+            momentum=_env("DSGD_MOMENTUM", cls.momentum, float),
         )
         return dataclasses.replace(cfg, **overrides)
 

@@ -22,6 +22,7 @@ class LossChecker:
         leaky_loss: float,
         criterion: Optional[Criterion] = None,
         checkpointer=None,
+        save_every: int = 10,
     ):
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
@@ -34,6 +35,11 @@ class LossChecker:
         # step counter must not save below the previous run's snapshots
         # (restore_latest picks the max step)
         self.checkpointer = checkpointer
+        # write cadence: every improvement, plus every `save_every`-th
+        # non-improving check (bounds history lost to a crash without
+        # paying a blocking orbax write per check on long plateaus)
+        self.save_every = max(1, int(save_every))
+        self._checks_since_save = 0
         self._step_base = 0
         self.smoothed: List[float] = []  # newest first
         self.smoothed_accs: List[float] = []  # newest first
@@ -75,19 +81,31 @@ class LossChecker:
         acc = self.leaky * raw_acc + (1 - self.leaky) * prev_acc
         self.smoothed.insert(0, loss)
         self.smoothed_accs.insert(0, acc)
-        if loss < self.best_loss:  # MasterAsync.scala:130-139
+        improved = loss < self.best_loss  # MasterAsync.scala:130-139
+        if improved:
             self.best_loss = loss
             self.best_weights = np.asarray(weights)
-            if self.checkpointer is not None:
-                self.checkpointer.save(
-                    self._step_base + (step if step is not None else len(self.smoothed)),
-                    self.best_weights,
-                    extra={
-                        "best_loss": loss,
-                        "smoothed_nf": np.asarray(self.smoothed, np.float32),
-                        "smoothed_accs_nf": np.asarray(self.smoothed_accs, np.float32),
-                    },
-                )
+        self._checks_since_save += 1
+        if self.checkpointer is not None and (
+            improved or self._checks_since_save >= self.save_every
+        ):
+            # the snapshot always carries the best-so-far weights — so
+            # restore_latest returns the reference's "best"
+            # (MasterAsync.scala:91) — plus the complete smoothing/stopping
+            # history, so a resumed run's patience window does not restart
+            # at the last improvement.  Non-improving checks persist at the
+            # save_every cadence (a blocking orbax write per check would be
+            # O(n^2) I/O over a long plateau)
+            self.checkpointer.save(
+                self._step_base + (step if step is not None else len(self.smoothed)),
+                self.best_weights if self.best_weights is not None else weights,
+                extra={
+                    "best_loss": self.best_loss,
+                    "smoothed_nf": np.asarray(self.smoothed, np.float32),
+                    "smoothed_accs_nf": np.asarray(self.smoothed_accs, np.float32),
+                },
+            )
+            self._checks_since_save = 0
         return self.criterion is not None and self.criterion(self.smoothed)
 
     @property

@@ -62,10 +62,13 @@ class SyncTrainer:
         checkpoint_every: int = 1,
         kernel: str = "mxu",
         virtual_workers: int = 1,
+        optimizer=None,
+        momentum: float = 0.9,
     ):
         self.engine = SyncEngine(
             model, mesh, batch_size, learning_rate, sampling=sampling,
             kernel=kernel, virtual_workers=virtual_workers,
+            optimizer=optimizer, momentum=momentum,
         )
         self.model = model
         self.metrics = metrics or metrics_mod.global_metrics()
@@ -105,6 +108,25 @@ class SyncTrainer:
                     test_losses_newest_first = [
                         float(x) for x in np.asarray(state["test_losses_nf"])
                     ]
+                # optimizer continuity: momentum/adam buffers resume where
+                # they left off (a zeroed adam state on converged weights
+                # would bias-correct into a large first step).  A leaf-count
+                # mismatch means the checkpoint was written under a
+                # different optimizer — refuse rather than silently resume
+                # with zeroed or misassembled state
+                opt_leaves = []
+                while f"opt_{len(opt_leaves)}" in state:
+                    opt_leaves.append(state[f"opt_{len(opt_leaves)}"])
+                n_expected = len(bound_train.opt_state_leaves())
+                if len(opt_leaves) != n_expected:
+                    raise ValueError(
+                        f"checkpoint carries {len(opt_leaves)} optimizer-state "
+                        f"leaves but the configured optimizer expects "
+                        f"{n_expected}; resume with the optimizer the run was "
+                        f"started with, or point at a fresh checkpoint_dir"
+                    )
+                if opt_leaves:
+                    bound_train.load_opt_state_leaves(opt_leaves)
                 log.info("resumed from checkpoint at epoch %d", start_epoch)
 
         # prefer the second epoch (steady-state, compile excluded) but fall
@@ -148,7 +170,7 @@ class SyncTrainer:
 
             if self.checkpointer is not None and (epoch + 1) % self.checkpoint_every == 0:
                 self.checkpointer.save(epoch + 1, w, extra=self._ckpt_extra(
-                    test_losses_newest_first))
+                    test_losses_newest_first, bound_train))
 
             if criterion is not None and criterion(test_losses_newest_first):
                 log.info("Converged to target: stopping computation")
@@ -165,7 +187,7 @@ class SyncTrainer:
             and result.epochs_run % self.checkpoint_every != 0
         ):
             self.checkpointer.save(result.epochs_run, w, extra=self._ckpt_extra(
-                test_losses_newest_first))
+                test_losses_newest_first, bound_train))
         if self.profile_dir is not None and not profiled:
             log.warning(
                 "no profiler trace captured: the fit stopped before epoch %d",
@@ -178,10 +200,13 @@ class SyncTrainer:
         return result
 
     @staticmethod
-    def _ckpt_extra(test_losses_newest_first: List[float]):
-        if not test_losses_newest_first:
-            return None
-        return {"test_losses_nf": np.asarray(test_losses_newest_first, np.float32)}
+    def _ckpt_extra(test_losses_newest_first: List[float], bound):
+        extra = {}
+        if test_losses_newest_first:
+            extra["test_losses_nf"] = np.asarray(test_losses_newest_first, np.float32)
+        for i, leaf in enumerate(bound.opt_state_leaves()):
+            extra[f"opt_{i}"] = np.asarray(leaf)
+        return extra or None
 
     def predict(self, weights: jax.Array, data: Dataset):
         """Predictions over a split (Master.predict, Master.scala:61-75)."""

@@ -177,6 +177,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             learning_rate=cfg.learning_rate, seed=cfg.seed,
             kernel=cfg.kernel, virtual_workers=virtual,
             checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
+            optimizer=cfg.optimizer, momentum=cfg.momentum,
         )
         res = trainer.fit(train, test, cfg.max_epochs, criterion)
 

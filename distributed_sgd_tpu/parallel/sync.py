@@ -93,6 +93,8 @@ class BoundSync:
         eval_chunk: int = 4096,
         kernel: str = "mxu",
         virtual_workers: int = 1,
+        optimizer=None,
+        momentum: float = 0.9,
     ):
         if sampling not in ("fresh", "epoch"):
             raise ValueError(f"sampling must be 'fresh' or 'epoch', got {sampling!r}")
@@ -145,13 +147,21 @@ class BoundSync:
         max_shard = math.ceil(data.n_true / (self.n_workers * self.virtual_workers))
         self.steps_per_epoch = steps_per_epoch or max(1, math.ceil(max_shard / self.batch_size))
 
+        # optional optax optimizer (capability superset; the reference is
+        # plain SGD, Master.scala:197).  None = reference update w - lr*g.
+        # State lives in the kernel's weight layout and is threaded through
+        # every compiled loop, replicated over the mesh like the weights.
+        self.opt = resolve_optimizer(optimizer, self.learning_rate, momentum)
+        self._opt_state = self._init_opt_state()
+        sspec = jax.tree.map(lambda _: P(), self._opt_state)
+
         dspec = (P(AXIS), P(AXIS), P(AXIS))
         self._epoch = jax.jit(
             jax.shard_map(
                 self._epoch_shard,
                 mesh=mesh,
-                in_specs=(P(),) + dspec + (P(),),
-                out_specs=P(),
+                in_specs=(P(), sspec) + dspec + (P(),),
+                out_specs=(P(), sspec),
                 check_vma=self._check_vma,
             )
         )
@@ -159,11 +169,12 @@ class BoundSync:
             jax.shard_map(
                 self._step_shard,
                 mesh=mesh,
-                in_specs=(P(),) + dspec + (P(),),
-                out_specs=P(),
+                in_specs=(P(), sspec) + dspec + (P(),),
+                out_specs=(P(), sspec),
                 check_vma=self._check_vma,
             )
         )
+        self._sspec = sspec
         self._eval = jax.jit(
             jax.shard_map(
                 self._eval_shard,
@@ -223,9 +234,10 @@ class BoundSync:
         g = self.model.grad_sum(w, batch, by)
         return self.model.regularize(g, w)
 
-    def _one_step(self, w, idx, val, y, key, step):
+    def _one_step(self, w, opt_state, idx, val, y, key, step):
         """One sync DP step on weights in the kernel's native layout:
-        dense [D] for 'scalar', lane-blocked [R, 128] for 'mxu'/'pallas'."""
+        dense [D] for 'scalar'/'dense', lane-blocked [R, 128] for
+        'mxu'/'pallas'.  Returns (w', opt_state')."""
         ids = self._sample_ids(key, step)  # [K, B]
         if self.kernel == "pallas":
             from distributed_sgd_tpu.ops import pallas_sparse
@@ -245,7 +257,12 @@ class BoundSync:
             g = jnp.sum(gk, axis=0)  # summed here, mean-normalized below
         # master mean over ALL workers (Master.scala:194)
         g = jax.lax.psum(g, AXIS) / (self.n_workers * self.virtual_workers)
-        return w - self.learning_rate * g
+        if self.opt is None:  # reference update (Master.scala:197)
+            return w - self.learning_rate * g, opt_state
+        import optax
+
+        updates, opt_state = self.opt.update(g, opt_state, w)
+        return optax.apply_updates(w, updates), opt_state
 
     @property
     def _blocked_layout(self) -> bool:
@@ -261,22 +278,23 @@ class BoundSync:
             return mxu.from_blocked(w, self.model.n_features)
         return w
 
-    def _epoch_shard(self, w, idx, val, y, key):
+    def _epoch_shard(self, w, opt_state, idx, val, y, key):
         key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
         w = self._to_kernel_layout(w)
 
-        def body(w, step):
-            return self._one_step(w, idx, val, y, key, step), ()
+        def body(carry, step):
+            return self._one_step(*carry, idx, val, y, key, step), ()
 
-        w, _ = jax.lax.scan(body, w, jnp.arange(self.steps_per_epoch))
-        return self._from_kernel_layout(w)
-
-    def _step_shard(self, w, idx, val, y, key):
-        key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
-        w = self._to_kernel_layout(w)
-        return self._from_kernel_layout(
-            self._one_step(w, idx, val, y, key, jnp.int32(0))
+        (w, opt_state), _ = jax.lax.scan(
+            body, (w, opt_state), jnp.arange(self.steps_per_epoch)
         )
+        return self._from_kernel_layout(w), opt_state
+
+    def _step_shard(self, w, opt_state, idx, val, y, key):
+        key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
+        w = self._to_kernel_layout(w)
+        w, opt_state = self._one_step(w, opt_state, idx, val, y, key, jnp.int32(0))
+        return self._from_kernel_layout(w), opt_state
 
     def _chunk_margins(self, w_layout, batch: SparseBatch) -> jax.Array:
         """Per-sample margins with the kernel matching the weight layout.
@@ -343,7 +361,7 @@ class BoundSync:
         _, preds = jax.lax.scan(body, (), jnp.arange(n_chunks))
         return preds.reshape(-1)
 
-    def _multi_epoch_shard(self, n_epochs, w, idx, val, y, key):
+    def _multi_epoch_shard(self, n_epochs, w, opt_state, idx, val, y, key):
         key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
         w = self._to_kernel_layout(w)
 
@@ -351,13 +369,13 @@ class BoundSync:
             ke = jax.random.fold_in(key, e)
 
             def body(c2, step):
-                return self._one_step(c2, idx, val, y, ke, step), ()
+                return self._one_step(*c2, idx, val, y, ke, step), ()
 
             c, _ = jax.lax.scan(body, c, jnp.arange(self.steps_per_epoch))
             return c, ()
 
-        w, _ = jax.lax.scan(epoch_body, w, jnp.arange(n_epochs))
-        return self._from_kernel_layout(w)
+        (w, opt_state), _ = jax.lax.scan(epoch_body, (w, opt_state), jnp.arange(n_epochs))
+        return self._from_kernel_layout(w), opt_state
 
     def _check_trainable(self) -> None:
         """Checked at train-call time, not bind time: an eval-only binding
@@ -385,7 +403,11 @@ class BoundSync:
 
     def epoch(self, w: jax.Array, key: jax.Array) -> jax.Array:
         self._check_trainable()
-        return self._epoch(w, self.data.indices, self.data.values, self.data.labels, key)
+        w, self._opt_state = self._epoch(
+            w, self._opt_state, self.data.indices, self.data.values,
+            self.data.labels, key,
+        )
+        return w
 
     def multi_epoch(self, w: jax.Array, key: jax.Array, n_epochs: int) -> jax.Array:
         """Run `n_epochs` epochs in ONE device dispatch (per-epoch key fold).
@@ -403,18 +425,46 @@ class BoundSync:
                 jax.shard_map(
                     functools.partial(self._multi_epoch_shard, n_epochs),
                     mesh=self.mesh,
-                    in_specs=(P(),) + (P(AXIS), P(AXIS), P(AXIS)) + (P(),),
-                    out_specs=P(),
+                    in_specs=(P(), self._sspec) + (P(AXIS), P(AXIS), P(AXIS)) + (P(),),
+                    out_specs=(P(), self._sspec),
                     check_vma=self._check_vma,
                 )
             )
-        return self._multi_cache[n_epochs](
-            w, self.data.indices, self.data.values, self.data.labels, key
+        w, self._opt_state = self._multi_cache[n_epochs](
+            w, self._opt_state, self.data.indices, self.data.values,
+            self.data.labels, key,
         )
+        return w
 
     def step(self, w: jax.Array, key: jax.Array) -> jax.Array:
         self._check_trainable()
-        return self._step(w, self.data.indices, self.data.values, self.data.labels, key)
+        w, self._opt_state = self._step(
+            w, self._opt_state, self.data.indices, self.data.values,
+            self.data.labels, key,
+        )
+        return w
+
+    def _init_opt_state(self):
+        if self.opt is None:
+            return ()
+        return self.opt.init(
+            self._to_kernel_layout(jnp.zeros((self.model.n_features,), jnp.float32))
+        )
+
+    def reset_optimizer(self) -> None:
+        """Zero the optimizer state (momentum buffers etc.)."""
+        self._opt_state = self._init_opt_state()
+
+    def opt_state_leaves(self):
+        """Optimizer state as a flat list of arrays (checkpoint form)."""
+        return jax.tree.leaves(self._opt_state)
+
+    def load_opt_state_leaves(self, leaves) -> None:
+        """Restore optimizer state from `opt_state_leaves()` output."""
+        treedef = jax.tree.structure(self._opt_state)
+        self._opt_state = jax.tree.unflatten(
+            treedef, [jnp.asarray(x) for x in leaves]
+        )
 
     def predict(self, w: jax.Array) -> np.ndarray:
         """Model predictions for every (true) sample in the bound split,
@@ -435,6 +485,26 @@ class BoundSync:
         return reg + loss_sum / n, hit_sum / n
 
 
+def resolve_optimizer(optimizer, learning_rate: float, momentum: float = 0.9):
+    """None/'sgd' -> None (the reference's plain update, Master.scala:197);
+    'momentum'/'adam' -> the optax transformation at `learning_rate`; an
+    optax GradientTransformation passes through untouched."""
+    if optimizer is None or optimizer == "sgd":
+        return None
+    if isinstance(optimizer, str):
+        import optax
+
+        if optimizer == "momentum":
+            return optax.sgd(learning_rate, momentum=momentum)
+        if optimizer == "adam":
+            return optax.adam(learning_rate)
+        raise ValueError(
+            f"optimizer must be 'sgd', 'momentum', 'adam' or an optax "
+            f"GradientTransformation, got {optimizer!r}"
+        )
+    return optimizer
+
+
 class SyncEngine:
     """Factory: shards datasets onto the mesh and binds compiled loops."""
 
@@ -448,6 +518,8 @@ class SyncEngine:
         eval_chunk: int = 4096,
         kernel: str = "mxu",
         virtual_workers: int = 1,
+        optimizer=None,
+        momentum: float = 0.9,
     ):
         self.model = model
         self.mesh = mesh
@@ -457,6 +529,8 @@ class SyncEngine:
         self.eval_chunk = eval_chunk
         self.kernel = kernel
         self.virtual_workers = virtual_workers
+        self.optimizer = optimizer
+        self.momentum = momentum
 
     def bind(self, data: Dataset, steps_per_epoch: Optional[int] = None) -> BoundSync:
         n_workers = self.mesh.shape[AXIS]
@@ -486,6 +560,8 @@ class SyncEngine:
             eval_chunk=chunk,
             kernel=kernel,
             virtual_workers=self.virtual_workers,
+            optimizer=self.optimizer,
+            momentum=self.momentum,
         )
 
 

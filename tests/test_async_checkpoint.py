@@ -24,15 +24,19 @@ def _data(seed=50):
 
 
 def test_loss_checker_persists_best(tmp_path):
+    """Every check persists; the snapshot always carries the BEST weights
+    (reference 'return best', MasterAsync.scala:91) plus the full history
+    so a resumed patience window doesn't restart at the last improvement."""
     ckpt = Checkpointer(str(tmp_path / "ck"))
-    checker = LossChecker(1.0, checkpointer=ckpt)
+    checker = LossChecker(1.0, checkpointer=ckpt, save_every=1)
     w1, w2 = np.ones(4, np.float32), np.full(4, 2.0, np.float32)
-    checker.check(0.5, 0.9, w1, step=10)   # best -> saved
-    checker.check(0.9, 0.8, w2, step=20)   # worse -> NOT saved
+    checker.check(0.5, 0.9, w1, step=10)   # best
+    checker.check(0.9, 0.8, w2, step=20)   # worse: saved too, best weights
     step, state = ckpt.restore_latest()
-    assert step == 10
-    np.testing.assert_array_equal(np.asarray(state["weights"]), w1)
+    assert step == 20
+    np.testing.assert_array_equal(np.asarray(state["weights"]), w1)  # BEST
     assert float(state["best_loss"]) == 0.5
+    assert len(np.asarray(state["smoothed_nf"])) == 2  # full history kept
     ckpt.close()
 
 
@@ -81,12 +85,14 @@ def test_resumed_checker_keeps_prior_best(tmp_path):
     LossChecker(1.0, checkpointer=ckpt).check(0.2, 0.9, w_best, step=300)
     ckpt.close()
     ckpt2 = Checkpointer(str(tmp_path / "ck"))
-    c2 = LossChecker(1.0, checkpointer=ckpt2)
+    c2 = LossChecker(1.0, checkpointer=ckpt2, save_every=1)
     assert c2.best_loss == pytest.approx(0.2)
     c2.check(0.9, 0.5, np.full(4, 9.0, np.float32), step=0)  # worse
     step, state = ckpt2.restore_latest()
-    assert step == 300  # nothing newer was written
+    assert step == 301  # the check persisted (history continuity) ...
+    # ... but still carries the prior run's BEST weights, not the worse ones
     np.testing.assert_array_equal(np.asarray(state["weights"]), w_best)
+    assert float(state["best_loss"]) == pytest.approx(0.2)
     np.testing.assert_array_equal(np.asarray(c2.best_weights), w_best)
     ckpt2.close()
 
@@ -115,6 +121,68 @@ def test_sync_trainer_resume_continues_early_stop_history(tmp_path):
     r2 = t2.fit(train, test, max_epochs=10, criterion=needs_four)
     ckpt2.close()
     assert r2.epochs_run == 4  # stopped after ONE post-resume epoch
+
+
+def test_loss_checker_save_throttling(tmp_path):
+    """Non-improving checks persist only at the save_every cadence, so a
+    long plateau does not pay a blocking write per check."""
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    c = LossChecker(1.0, checkpointer=ckpt, save_every=3)
+    w = np.ones(4, np.float32)
+    c.check(0.5, 0.9, w, step=1)            # improvement -> saved
+    c.check(0.9, 0.9, w, step=2)            # plateau 1 -> skipped
+    c.check(0.9, 0.9, w, step=3)            # plateau 2 -> skipped
+    assert ckpt.latest_step() == 1
+    c.check(0.9, 0.9, w, step=4)            # plateau 3 -> cadence save
+    assert ckpt.latest_step() == 4
+    ckpt.close()
+
+
+def test_sync_trainer_resume_refuses_optimizer_mismatch(tmp_path):
+    """Resuming under a different optimizer than the checkpoint was
+    written with must fail loudly, not silently zero the state."""
+    from distributed_sgd_tpu.core.trainer import SyncTrainer
+
+    train, test = _data(seed=55)
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    SyncTrainer(model, make_mesh(2), 16, 0.1, optimizer="momentum",
+                checkpointer=ckpt).fit(train, test, max_epochs=1)
+    ckpt.close()
+
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    t2 = SyncTrainer(model, make_mesh(2), 16, 0.1,  # plain sgd now
+                     checkpointer=ckpt2)
+    with pytest.raises(ValueError, match="optimizer"):
+        t2.fit(train, test, max_epochs=2)
+    ckpt2.close()
+
+
+def test_sync_trainer_resume_restores_optimizer_state(tmp_path):
+    """A killed-and-resumed momentum run must match the uninterrupted run
+    exactly — which requires the momentum buffers to be checkpointed."""
+    from distributed_sgd_tpu.core.trainer import SyncTrainer
+
+    train, test = _data(seed=54)
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    t1 = SyncTrainer(model, make_mesh(2), 16, 0.1, optimizer="momentum",
+                     checkpointer=ckpt)
+    t1.fit(train, test, max_epochs=2)
+    ckpt.close()  # "kill"
+
+    ckpt2 = Checkpointer(str(tmp_path / "ck"))
+    t2 = SyncTrainer(model, make_mesh(2), 16, 0.1, optimizer="momentum",
+                     checkpointer=ckpt2)
+    r2 = t2.fit(train, test, max_epochs=4)  # resumes at epoch 2
+    ckpt2.close()
+
+    t3 = SyncTrainer(model, make_mesh(2), 16, 0.1, optimizer="momentum")
+    r3 = t3.fit(train, test, max_epochs=4)  # uninterrupted
+    np.testing.assert_allclose(np.asarray(r2.state.weights),
+                               np.asarray(r3.state.weights),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_sync_trainer_saves_final_state_off_cadence(tmp_path):

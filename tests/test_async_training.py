@@ -95,6 +95,30 @@ def test_hogwild_kstep_blocked_matches_unblocked(monkeypatch):
     np.testing.assert_allclose(da, db, rtol=1e-5, atol=1e-6)
 
 
+def test_hogwild_stress_many_workers_clean_shutdown():
+    """Concurrency stress (SURVEY §5.2): 8 workers gossiping with k>1,
+    overload-sized inboxes forcing drop-oldest, tight loss checks — the
+    fit must terminate cleanly within its budget, all worker threads must
+    join, and the result must be finite."""
+    import threading
+
+    train, test = _data()
+    eng = HogwildEngine(
+        _model(), n_workers=8, batch_size=4, learning_rate=0.01,
+        check_every=25, leaky_loss=0.5, backoff_s=0.01, seed=3,
+        steps_per_dispatch=4,
+    )
+    before = {t.name for t in threading.enumerate()}
+    res = eng.fit(train, test, max_epochs=20)
+    assert np.all(np.isfinite(np.asarray(res.state.weights)))
+    assert res.state.updates > 0
+    # no leaked hogwild worker threads after fit returns
+    after = [t for t in threading.enumerate()
+             if t.name.startswith("hogwild-") and t.is_alive()
+             and t.name not in before]
+    assert after == []
+
+
 def test_hogwild_early_stops_on_target():
     train, test = _data()
     eng = HogwildEngine(
