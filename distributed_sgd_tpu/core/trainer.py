@@ -70,6 +70,13 @@ class SyncTrainer:
             kernel=kernel, virtual_workers=virtual_workers,
             optimizer=optimizer, momentum=momentum,
         )
+        # checkpoint tag for structural resume validation: string-configured
+        # optimizers validate by name; arbitrary optax transformations all
+        # tag 'custom' (their identity is not recoverable from a string)
+        self._opt_kind = (
+            optimizer if isinstance(optimizer, str)
+            else ("sgd" if optimizer is None else "custom")
+        )
         self.model = model
         self.metrics = metrics or metrics_mod.global_metrics()
         self.seed = seed
@@ -110,20 +117,36 @@ class SyncTrainer:
                     ]
                 # optimizer continuity: momentum/adam buffers resume where
                 # they left off (a zeroed adam state on converged weights
-                # would bias-correct into a large first step).  A leaf-count
-                # mismatch means the checkpoint was written under a
-                # different optimizer — refuse rather than silently resume
-                # with zeroed or misassembled state
+                # would bias-correct into a large first step).  Refuse a
+                # checkpoint written under a different optimizer kind, leaf
+                # count, or leaf shape (e.g. a kernel-layout change) rather
+                # than silently resuming with zeroed or misassembled state
+                saved_kind = (
+                    bytes(np.asarray(state["opt_kind"], np.uint8)).decode()
+                    if "opt_kind" in state else "sgd"
+                )
+                if saved_kind != self._opt_kind:
+                    raise ValueError(
+                        f"checkpoint was written with optimizer "
+                        f"{saved_kind!r} but this run is configured with "
+                        f"{self._opt_kind!r}; resume with the original "
+                        f"optimizer or point at a fresh checkpoint_dir"
+                    )
                 opt_leaves = []
                 while f"opt_{len(opt_leaves)}" in state:
                     opt_leaves.append(state[f"opt_{len(opt_leaves)}"])
-                n_expected = len(bound_train.opt_state_leaves())
-                if len(opt_leaves) != n_expected:
+                expected = bound_train.opt_state_leaves()
+                shapes_ok = len(opt_leaves) == len(expected) and all(
+                    np.shape(g) == np.shape(e) for g, e in zip(opt_leaves, expected)
+                )
+                if not shapes_ok:
                     raise ValueError(
-                        f"checkpoint carries {len(opt_leaves)} optimizer-state "
-                        f"leaves but the configured optimizer expects "
-                        f"{n_expected}; resume with the optimizer the run was "
-                        f"started with, or point at a fresh checkpoint_dir"
+                        f"checkpointed optimizer-state leaves "
+                        f"{[np.shape(x) for x in opt_leaves]} do not match the "
+                        f"configured optimizer/kernel layout "
+                        f"{[np.shape(x) for x in expected]}; resume with the "
+                        f"original optimizer and kernel, or use a fresh "
+                        f"checkpoint_dir"
                     )
                 if opt_leaves:
                     bound_train.load_opt_state_leaves(opt_leaves)
@@ -199,14 +222,14 @@ class SyncTrainer:
         ).finish()
         return result
 
-    @staticmethod
-    def _ckpt_extra(test_losses_newest_first: List[float], bound):
+    def _ckpt_extra(self, test_losses_newest_first: List[float], bound):
         extra = {}
         if test_losses_newest_first:
             extra["test_losses_nf"] = np.asarray(test_losses_newest_first, np.float32)
+        extra["opt_kind"] = np.frombuffer(self._opt_kind.encode(), dtype=np.uint8)
         for i, leaf in enumerate(bound.opt_state_leaves()):
             extra[f"opt_{i}"] = np.asarray(leaf)
-        return extra or None
+        return extra
 
     def predict(self, weights: jax.Array, data: Dataset):
         """Predictions over a split (Master.predict, Master.scala:61-75)."""

@@ -157,6 +157,16 @@ def test_sync_trainer_resume_refuses_optimizer_mismatch(tmp_path):
         t2.fit(train, test, max_epochs=2)
     ckpt2.close()
 
+    # same optimizer, different kernel layout: momentum trace was saved
+    # blocked [R, 128]; the scalar kernel expects [D] — refuse with the
+    # friendly message, not a deep jit shape error
+    ckpt3 = Checkpointer(str(tmp_path / "ck"))
+    t3 = SyncTrainer(model, make_mesh(2), 16, 0.1, optimizer="momentum",
+                     kernel="scalar", checkpointer=ckpt3)
+    with pytest.raises(ValueError, match="kernel"):
+        t3.fit(train, test, max_epochs=2)
+    ckpt3.close()
+
 
 def test_sync_trainer_resume_restores_optimizer_state(tmp_path):
     """A killed-and-resumed momentum run must match the uninterrupted run
