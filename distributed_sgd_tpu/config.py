@@ -68,6 +68,7 @@ class Config:
     exact_topology: bool = False  # insist on exactly node_count workers
     optimizer: str = "sgd"  # sgd (reference) | momentum | adam (sync engine)
     momentum: float = 0.9  # used by optimizer='momentum'
+    steps_per_dispatch: int = 1  # async: k local steps per gossip dispatch
 
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
@@ -93,6 +94,8 @@ class Config:
             raise ValueError("virtual_workers must be >= 1")
         if self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
+        if self.steps_per_dispatch < 1:
+            raise ValueError("steps_per_dispatch must be >= 1")
         if self.exact_topology and self.virtual_workers != 1:
             raise ValueError(
                 "exact_topology and an explicit virtual_workers are mutually "
@@ -146,6 +149,7 @@ class Config:
             exact_topology=_env("DSGD_EXACT_TOPOLOGY", cls.exact_topology, bool),
             optimizer=_env("DSGD_OPTIMIZER", cls.optimizer, str),
             momentum=_env("DSGD_MOMENTUM", cls.momentum, float),
+            steps_per_dispatch=_env("DSGD_STEPS_PER_DISPATCH", cls.steps_per_dispatch, int),
         )
         return dataclasses.replace(cfg, **overrides)
 

@@ -35,6 +35,7 @@ class DevCluster:
         devices=None,
         seed: int = 0,
         heartbeat_s: Optional[float] = None,
+        steps_per_dispatch: int = 1,
     ):
         devs = list(devices if devices is not None else jax.devices())
         self.master = MasterNode(
@@ -47,6 +48,7 @@ class DevCluster:
             w = WorkerNode(
                 host, port, host, self.master.port, train, model,
                 device=devs[i % len(devs)], seed=seed + i,
+                steps_per_dispatch=steps_per_dispatch,
             )
             self.workers.append(w)
         for w in self.workers:

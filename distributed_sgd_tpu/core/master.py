@@ -570,15 +570,18 @@ class MasterNode:
             except grpc.RpcError:
                 pass
 
-    # master UpdateGrad RPC (MasterAsync.scala:164-177)
-    def _update_grad(self, delta: np.ndarray) -> None:
+    # master UpdateGrad RPC (MasterAsync.scala:164-177); one gossip message
+    # may carry n_steps summed local steps (dispatch amortization) and
+    # maxSteps counts local steps
+    def _update_grad(self, delta: np.ndarray, n_steps: int = 1) -> None:
         with self._async_lock:
             if self._w_async is None:
                 return
             self._w_async = self._apply(self._w_async, jnp.asarray(delta))
-            self._updates += 1
+            stride = max(1, int(n_steps))
+            self._updates += stride
             updates = self._updates
-        if updates % 1000 == 0:
+        if updates % 1000 < stride:  # crossing check: strides of k
             self.log.info("%d updates received", updates)
         if updates >= self._max_steps and self._async_running.is_set():
             self.log.info("max number of steps reached: stopping computation")
@@ -608,5 +611,5 @@ class _MasterServicer:
         return pb.Ack()
 
     def UpdateGrad(self, request, context):  # noqa: N802
-        self.m._update_grad(codec.decode_grad(request))
+        self.m._update_grad(codec.decode_grad(request), n_steps=request.n_steps or 1)
         return pb.Ack()

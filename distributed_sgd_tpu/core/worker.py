@@ -59,6 +59,7 @@ class WorkerNode:
         device=None,
         seed: int = 0,
         metrics: Optional[metrics_mod.Metrics] = None,
+        steps_per_dispatch: int = 1,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -66,6 +67,11 @@ class WorkerNode:
         self.model = model
         self.device = device if device is not None else jax.devices()[0]
         self.seed = seed
+        # k local SGD steps per compiled dispatch; the summed delta is
+        # gossiped every k steps (deltas commute — same amortization as
+        # parallel/hogwild.py, GradUpdate.n_steps carries k on the wire).
+        # k=1 is the reference's per-step gossip (Slave.scala:103-105)
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
 
         # device-resident copy of the full dataset (the reference slave also
         # holds the full data and receives sample indices, Main.scala:138)
@@ -250,27 +256,40 @@ class WorkerNode:
         bs, lr = self._async_bs, self._async_lr
         n_assigned = int(self._assignment.shape[0])
         model = self.model
+        ksteps = self.steps_per_dispatch
 
         blocked = self._blocked_device()
 
-        def step(w, assignment, idx, val, y, key):
-            ids = assignment[jax.random.randint(key, (bs,), 0, n_assigned)]
-            batch = SparseBatch(idx[ids], val[ids])
-            # MEAN reduce (Slave.scala:93-98) + regularize (Slave.scala:99)
-            return lr * model.grad_regularized(
-                w, batch, y[ids], reduce="mean", blocked=blocked
-            )
+        def kstep(w, assignment, idx, val, y, key):
+            # k local SGD steps in ONE compiled dispatch; returns the
+            # SUMMED delta for gossip (commutative merge — peers applying
+            # the sum see exactly the k individual w <- w - delta merges,
+            # just k steps later; staleness bounded by k)
+            def body(carry, kk):
+                w_t, acc = carry
+                ids = assignment[jax.random.randint(kk, (bs,), 0, n_assigned)]
+                batch = SparseBatch(idx[ids], val[ids])
+                # MEAN reduce (Slave.scala:93-98) + regularize (Slave:99)
+                delta = lr * model.grad_regularized(
+                    w_t, batch, y[ids], reduce="mean", blocked=blocked
+                )
+                return (w_t - delta, acc + delta), None
 
-        step = jax.jit(step)
+            keys = jax.random.split(key, ksteps)
+            (_, acc), _ = jax.lax.scan(body, (w, jnp.zeros_like(w)), keys)
+            return acc
+
+        kstep = jax.jit(kstep)
         key = jax.random.PRNGKey(self.seed + self.port)
         while self._running_async.is_set():
             key, k = jax.random.split(key)
             snapshot = self._w  # stale read is the algorithm
-            delta = step(snapshot, self._assignment, self._idx, self._val, self._y, k)
+            delta = kstep(snapshot, self._assignment, self._idx, self._val, self._y, k)
             with self._w_lock:
                 self._w = self._apply(self._w, delta)
-            self.metrics.counter("slave.async.batch").increment()
+            self.metrics.counter("slave.async.batch").increment(ksteps)
             msg = codec.encode_grad(np.asarray(delta))
+            msg.n_steps = ksteps
             with self._peers_lock:
                 peers = list(self._peers.values())
             for peer in peers:  # fire-and-forget (Slave.scala:103-105)

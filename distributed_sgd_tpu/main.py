@@ -155,6 +155,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             model, n_workers=cfg.node_count, batch_size=cfg.batch_size,
             learning_rate=cfg.learning_rate, check_every=cfg.check_every,
             leaky_loss=cfg.leaky_loss, seed=cfg.seed, checkpointer=ckpt,
+            steps_per_dispatch=cfg.steps_per_dispatch,
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion,
                       initial_weights=_restore_weights(ckpt))
@@ -189,7 +190,8 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     from distributed_sgd_tpu.core.cluster import DevCluster
 
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
-    with DevCluster(model, train, test, n_workers=cfg.node_count, seed=cfg.seed) as c:
+    with DevCluster(model, train, test, n_workers=cfg.node_count, seed=cfg.seed,
+                    steps_per_dispatch=cfg.steps_per_dispatch) as c:
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
@@ -278,7 +280,7 @@ def main() -> None:
         train, _, model = build(cfg)
         worker = WorkerNode(
             cfg.host, cfg.port, cfg.master_host, cfg.master_port, train, model,
-            seed=cfg.seed,
+            seed=cfg.seed, steps_per_dispatch=cfg.steps_per_dispatch,
         ).start()
         worker.await_termination()
 

@@ -275,7 +275,7 @@ class HogwildEngine:
             self._w_master = self._apply(self._w_master, jnp.asarray(delta))
             self._updates += n_steps
             updates = self._updates
-        if updates % 1000 == 0:
+        if updates % 1000 < max(1, n_steps):  # crossing check: strides of k
             log.info("%d updates received", updates)
         if updates >= self._max_steps:
             self._stop.set()

@@ -90,6 +90,25 @@ def test_sync_fit_over_rpc_converges(data):
         assert res.losses[-1] < res.losses[0]
 
 
+def test_async_fit_over_rpc_amortized_dispatch(data):
+    """steps_per_dispatch>1 on the RPC workers: summed deltas gossip with
+    n_steps on the wire, the master counts local steps (maxSteps budget
+    honored), and the fit converges."""
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2,
+                    steps_per_dispatch=4) as c:
+        res = c.master.fit_async(
+            max_epochs=10, batch_size=8, learning_rate=0.02,
+            check_every=40, leaky_loss=0.9, backoff_s=0.02,
+        )
+        max_steps = len(train) * 10
+        assert res.state.updates >= max_steps  # budget counted in LOCAL steps
+        # k=4 sums: message count is ~updates/4, so updates must be a
+        # multiple of 4 (both workers send k-step sums)
+        assert res.state.updates % 4 == 0
+        assert np.all(np.isfinite(np.asarray(res.state.weights)))
+
+
 def test_async_fit_over_rpc_returns_best(data):
     train, test = data
     with DevCluster(_model(), train, test, n_workers=2) as c:
