@@ -59,6 +59,16 @@ def load() -> Optional[ctypes.CDLL]:
             lib.dsgd_parse_svm.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int32]
             lib.dsgd_free_csr.argtypes = [ctypes.POINTER(_CsrResult)]
             lib.dsgd_free_csr.restype = None
+            lib.dsgd_pack_csr.restype = ctypes.c_int64
+            lib.dsgd_pack_csr.argtypes = [
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+                ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.POINTER(ctypes.c_float),
+            ]
             _lib = lib
         except Exception as e:  # missing toolchain etc. -> python fallback
             log.warning("native parser unavailable (%s); using python fallback", e)
@@ -88,3 +98,33 @@ def parse_svm_file(
         return doc_ids, row_ptr, col_idx, values
     finally:
         lib.dsgd_free_csr(res)
+
+
+def pack_csr(
+    row_ptr: np.ndarray, col_idx: np.ndarray, values: np.ndarray, p: int
+) -> Optional[Tuple[np.ndarray, np.ndarray, int]]:
+    """Native CSR -> padded [N, p] pack; None if the library is unavailable.
+
+    Returns (indices[N, p] int32, values[N, p] f32, n_truncated).  Rows
+    wider than p keep their p largest-|value| features (same policy as the
+    numpy fallback in data/rcv1.py).  ctypes releases the GIL for the call.
+    """
+    lib = load()
+    if lib is None:
+        return None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
+    values = np.ascontiguousarray(values, dtype=np.float32)
+    n = len(row_ptr) - 1
+    out_idx = np.zeros((n, p), dtype=np.int32)
+    out_val = np.zeros((n, p), dtype=np.float32)
+    truncated = lib.dsgd_pack_csr(
+        n,
+        row_ptr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        col_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        values.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        p,
+        out_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out_val.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return out_idx, out_val, int(truncated)

@@ -69,6 +69,26 @@ def test_native_parser_multithreaded_matches(rcv1_dir):
         np.testing.assert_array_equal(x, y)
 
 
+def test_native_parser_tolerates_messy_lines(tmp_path):
+    """Leading whitespace, '+'-prefixed numbers, malformed tokens, and a
+    non-numeric line: native and python parsers must agree (the strtol ->
+    from_chars migration dropped implicit whitespace/'+' handling)."""
+    p = tmp_path / "messy.dat"
+    p.write_text(
+        "  +10  1:0.5 2:+0.25\n"
+        "garbage line without numbers\n"
+        "11  3:abc 4:0.125 nocolon 5:1e-2\n"
+    )
+    native = _native.parse_svm_file(str(p))
+    assert native is not None
+    # (the python fallback mirrors the reference and would raise on the
+    # garbage line — Dataset.scala:24's parts(0).toInt; golden check only)
+    assert native[0].tolist() == [10, 11]
+    assert native[1].tolist() == [0, 2, 4]
+    assert native[2].tolist() == [0, 1, 3, 4]
+    np.testing.assert_allclose(native[3], [0.5, 0.25, 0.125, 0.01])
+
+
 def test_read_labels_last_topic_wins(rcv1_dir):
     labels = read_labels(rcv1_dir + "/rcv1-v2.topics.qrels")
     # 2286: C15 then CCAT -> +1; 2287: CCAT then GCAT -> overwritten to -1

@@ -1,0 +1,76 @@
+"""Full-scale data-pipeline proof (VERDICT r2 item 1).
+
+The reference gates its loader on the real dataset: all 804,414 rows parsed
+in < 40 s (src/test/scala/epfl/distributed/utils/DatasetTests.scala:11-23).
+The real files cannot be fetched here, so `data/corpus.py` writes a corpus
+with the same file layout, row format, row count, and nnz density, and the
+native parser + pack pipeline is held to the same wall-clock gate — on one
+CPU core, where the reference used JVM parallel collections on a multicore
+dev machine.  Measured numbers are recorded in BASELINE.md ("Cold start at
+reference scale")."""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.data import _native
+from distributed_sgd_tpu.data.corpus import N_ROWS_FULL, write_rcv1_corpus
+from distributed_sgd_tpu.data.rcv1 import load_rcv1, parse_svm_file_py
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="session")
+def corpus_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("rcv1_full"))
+    meta = write_rcv1_corpus(d)
+    return d, meta
+
+
+def test_full_scale_load_under_reference_gate(corpus_dir):
+    folder, meta = corpus_dir
+    assert _native.load() is not None, "native parser failed to build"
+    # the reference's sbt compile also happens outside its timed region
+    t0 = time.perf_counter()
+    ds = load_rcv1(folder, full=True)
+    dt = time.perf_counter() - t0
+
+    assert len(ds) == N_ROWS_FULL == 804414  # DatasetTests.scala:18
+    assert dt < 40.0, f"full-scale load took {dt:.1f}s (reference gate: 40s)"
+    assert set(np.unique(ds.labels)) == {-1, 1}
+    nnz = (ds.values != 0).sum(axis=1)
+    assert 60 < nnz.mean() < 90  # real RCV1 density ~76 distinct features/doc
+
+
+def test_python_fallback_parity_at_scale(corpus_dir):
+    """Native and python parsers agree on a full 23,149-row train file."""
+    folder, _ = corpus_dir
+    path = folder + "/lyrl2004_vectors_train.dat"
+    native = _native.parse_svm_file(path)
+    assert native is not None
+    py = parse_svm_file_py(path)
+    np.testing.assert_array_equal(native[0], py[0])  # doc ids
+    np.testing.assert_array_equal(native[1], py[1])  # row ptr
+    np.testing.assert_array_equal(native[2], py[2])  # col ids
+    # values: from_chars parses decimal -> f32 directly; python goes
+    # decimal -> f64 -> f32, which may double-round 1 ulp apart
+    np.testing.assert_allclose(native[3], py[3], rtol=1.2e-7)
+
+
+def test_native_pack_matches_numpy_fallback(corpus_dir, monkeypatch):
+    """CSR->padded pack parity, incl. heaviest-|v| truncation rows."""
+    folder, _ = corpus_dir
+    import distributed_sgd_tpu.data.rcv1 as rcv1_mod
+    from distributed_sgd_tpu.data.rcv1 import pack_csr, parse_svm_file
+
+    _, row_ptr, col_idx, values = parse_svm_file(
+        folder + "/lyrl2004_vectors_train.dat"
+    )
+    for pad in (None, 32):  # lossless and truncating
+        n_idx, n_val = pack_csr(row_ptr, col_idx, values, pad_width=pad)
+        monkeypatch.setattr(rcv1_mod._native, "pack_csr", lambda *a: None)
+        p_idx, p_val = pack_csr(row_ptr, col_idx, values, pad_width=pad)
+        monkeypatch.undo()
+        np.testing.assert_array_equal(n_idx, p_idx)
+        np.testing.assert_array_equal(n_val, p_val)
