@@ -73,3 +73,74 @@ class Checkpointer:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+# -- shared sync-fit snapshot contract ------------------------------------
+#
+# Both sync engines (mesh SyncTrainer, core/trainer.py; RPC
+# MasterNode.fit_sync, core/master.py) persist the same state keys —
+# weights, newest-first test-loss history, optimizer kind tag, flat
+# optimizer-state leaves — so their checkpoints are interchangeable.  The
+# contract lives here, once.
+
+
+def opt_kind_tag(optimizer) -> str:
+    """Checkpoint tag for structural resume validation: string-configured
+    optimizers validate by name; arbitrary optax transformations all tag
+    'custom' (their identity is not recoverable from a string)."""
+    if isinstance(optimizer, str):
+        return optimizer
+    return "sgd" if optimizer is None else "custom"
+
+
+def sync_fit_extra(
+    test_losses_newest_first, opt_kind: str, opt_leaves
+) -> Dict[str, Any]:
+    """Build the `extra` dict saved alongside the weights."""
+    extra: Dict[str, Any] = {}
+    if test_losses_newest_first:
+        extra["test_losses_nf"] = np.asarray(test_losses_newest_first, np.float32)
+    extra["opt_kind"] = np.frombuffer(opt_kind.encode(), dtype=np.uint8)
+    for i, leaf in enumerate(opt_leaves):
+        extra[f"opt_{i}"] = np.asarray(leaf)
+    return extra
+
+
+def decode_sync_fit_state(state: Dict[str, Any], opt_kind: str, expected_leaves):
+    """Decode + validate a sync-fit snapshot against the configured optimizer.
+
+    Returns (test_losses_newest_first, opt_leaves).  Refuses a snapshot
+    written under a different optimizer kind, leaf count, or leaf shape
+    (e.g. a kernel-layout change) rather than silently resuming with
+    zeroed or misassembled optimizer state.
+    """
+    test_nf = (
+        [float(x) for x in np.asarray(state["test_losses_nf"])]
+        if "test_losses_nf" in state else []
+    )
+    saved_kind = (
+        bytes(np.asarray(state["opt_kind"], np.uint8)).decode()
+        if "opt_kind" in state else "sgd"
+    )
+    if saved_kind != opt_kind:
+        raise ValueError(
+            f"checkpoint was written with optimizer {saved_kind!r} but this "
+            f"run is configured with {opt_kind!r}; resume with the original "
+            f"optimizer or point at a fresh checkpoint_dir"
+        )
+    opt_leaves = []
+    while f"opt_{len(opt_leaves)}" in state:
+        opt_leaves.append(state[f"opt_{len(opt_leaves)}"])
+    expected = list(expected_leaves)
+    shapes_ok = len(opt_leaves) == len(expected) and all(
+        np.shape(g) == np.shape(e) for g, e in zip(opt_leaves, expected)
+    )
+    if not shapes_ok:
+        raise ValueError(
+            f"checkpointed optimizer-state leaves "
+            f"{[np.shape(x) for x in opt_leaves]} do not match the configured "
+            f"optimizer/kernel layout {[np.shape(x) for x in expected]}; "
+            f"resume with the original optimizer and kernel, or use a fresh "
+            f"checkpoint_dir"
+        )
+    return test_nf, opt_leaves

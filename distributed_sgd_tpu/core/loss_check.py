@@ -86,7 +86,11 @@ class LossChecker:
             self.best_loss = loss
             self.best_weights = np.asarray(weights)
         self._checks_since_save += 1
-        if self.checkpointer is not None and (
+        # cadence saves require a genuine best snapshot: before the first
+        # finite-loss improvement, best_weights is None and saving would
+        # persist the CURRENT (possibly divergent) weights as "best"
+        # (ADVICE r2)
+        if self.checkpointer is not None and self.best_weights is not None and (
             improved or self._checks_since_save >= self.save_every
         ):
             # the snapshot always carries the best-so-far weights — so
@@ -98,7 +102,7 @@ class LossChecker:
             # O(n^2) I/O over a long plateau)
             self.checkpointer.save(
                 self._step_base + (step if step is not None else len(self.smoothed)),
-                self.best_weights if self.best_weights is not None else weights,
+                self.best_weights,
                 extra={
                     "best_loss": self.best_loss,
                     "smoothed_nf": np.asarray(self.smoothed, np.float32),

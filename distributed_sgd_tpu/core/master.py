@@ -112,7 +112,6 @@ class MasterNode:
         self.test = test
         self.expected_workers = expected_workers
         self.seed = seed
-        self._rng = np.random.default_rng(seed)
 
         self._workers: Dict[Tuple[str, int], WorkerStub] = {}
         self._channels: Dict[Tuple[str, int], grpc.Channel] = {}
@@ -363,6 +362,10 @@ class MasterNode:
         grad_timeout_s: float = 30.0,
         on_worker_death: str = "resplit",
         grad_retries: int = 1,
+        checkpointer=None,
+        checkpoint_every: int = 1,
+        optimizer=None,
+        momentum: float = 0.9,
     ) -> FitResult:
         """Fault-tolerant sync fit.
 
@@ -376,6 +379,18 @@ class MasterNode:
         retries the batch across the survivors with a fresh re-split;
         `on_worker_death="fail"` raises WITHOUT touching membership, so the
         caller can investigate the intact cluster.
+
+        Checkpointing mirrors the mesh SyncTrainer (core/trainer.py):
+        `checkpointer` saves weights + the newest-first test-loss history
+        (+ optimizer kind/leaves) every `checkpoint_every` epochs and the
+        fit resumes from the latest snapshot — same state keys, so the two
+        sync engines' checkpoints are interchangeable for plain SGD.
+
+        `optimizer` accepts the same surface as the mesh engine (None/'sgd'
+        = the reference's plain update, Master.scala:197; 'momentum'/'adam'/
+        an optax transformation): workers still return raw gradient sums
+        (Slave.scala:153) and the transformation is applied master-side
+        where the reference applies its update.
         """
         if on_worker_death not in ("resplit", "fail"):
             raise ValueError(f"on_worker_death must be resplit|fail, got {on_worker_death!r}")
@@ -393,9 +408,57 @@ class MasterNode:
         test_newest_first: List[float] = []
         tracker = _FailureTracker(grad_retries + 1)
 
-        for epoch in range(max_epochs):
+        from distributed_sgd_tpu.checkpoint import opt_kind_tag
+        from distributed_sgd_tpu.parallel.sync import resolve_optimizer
+
+        opt = resolve_optimizer(optimizer, learning_rate, momentum)
+        opt_kind = opt_kind_tag(optimizer)
+        opt_state = opt.init(jnp.asarray(w)) if opt is not None else None
+        if opt is not None:
+            import optax
+
+            @jax.jit
+            def _opt_step(w_, opt_state_, g_):
+                updates, opt_state_ = opt.update(g_, opt_state_, w_)
+                return optax.apply_updates(w_, updates), opt_state_
+
+        start_epoch = 0
+        if checkpointer is not None:
+            restored = checkpointer.restore_latest()
+            if restored is not None:
+                from distributed_sgd_tpu.checkpoint import decode_sync_fit_state
+
+                start_epoch, state = restored
+                w = np.asarray(state["weights"], dtype=np.float32)
+                expected = (
+                    jax.tree_util.tree_leaves(opt_state) if opt is not None else []
+                )
+                test_newest_first, opt_leaves = decode_sync_fit_state(
+                    state, opt_kind, expected
+                )
+                if opt is not None and opt_leaves:
+                    opt_state = jax.tree_util.tree_unflatten(
+                        jax.tree_util.tree_structure(opt_state),
+                        [jnp.asarray(x) for x in opt_leaves],
+                    )
+                self.log.info("resumed sync fit from checkpoint at epoch %d", start_epoch)
+
+        if start_epoch >= max_epochs:
+            loss, acc = self.local_loss(w)
+            self.log.info(
+                "checkpoint already at epoch %d >= max_epochs %d: nothing to "
+                "run (loss=%.6f acc=%.4f)", start_epoch, max_epochs, loss, acc)
+            result.epochs_run = start_epoch
+            result.state = GradState(weights=w, loss=loss).finish()
+            return result
+
+        for epoch in range(start_epoch, max_epochs):
             t0 = time.perf_counter()
             batch = 0
+            # keyed by absolute epoch: a resumed run draws the same per-epoch
+            # sample stream a fresh run would (mirrors SyncTrainer's
+            # fold_in(base_key, epoch))
+            rng = np.random.default_rng((self.seed, epoch))
             while batch < max_samples:
                 # live membership: heartbeat-driven unregister_worker (or a
                 # graceful leave) reaches the loop here, not at fit start
@@ -414,7 +477,7 @@ class MasterNode:
                 wmsg = codec.encode_tensor(w)
                 futs = []
                 for (key, stub), part in zip(members, parts):
-                    shuffled = self._rng.permutation(part)  # Master.scala:184
+                    shuffled = rng.permutation(part)  # Master.scala:184
                     ids = shuffled[batch : batch + batch_size]
                     try:
                         fut = stub.Gradient.future(
@@ -448,7 +511,12 @@ class MasterNode:
                     continue  # retry this batch window (survivors or re-split)
                 grads = [codec.decode_grad(reply) for _, reply in ok]
                 grad = np.mean(grads, axis=0)  # Vec.mean (Master.scala:194)
-                w = w - learning_rate * grad
+                if opt is None:
+                    w = w - learning_rate * grad  # Master.scala:197
+                else:
+                    w_j, opt_state = _opt_step(
+                        jnp.asarray(w), opt_state, jnp.asarray(grad))
+                    w = np.asarray(w_j)
                 self.metrics.histogram("master.sync.batch.duration").record(
                     time.perf_counter() - t_batch)
                 batch += batch_size
@@ -470,14 +538,35 @@ class MasterNode:
                 "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
                 epoch, loss, acc, test_loss, test_acc, epoch_s,
             )
+            if checkpointer is not None and (epoch + 1) % checkpoint_every == 0:
+                checkpointer.save(epoch + 1, w, extra=self._sync_ckpt_extra(
+                    test_newest_first, opt_kind, opt_state))
             if criterion is not None and criterion(test_newest_first):
                 self.log.info("Converged to target: stopping computation")
                 break
+
+        # off-cadence end (early stop, or max_epochs % checkpoint_every != 0):
+        # persist the final state so no run with a checkpointer ends unsaved
+        if (
+            checkpointer is not None
+            and result.epochs_run > start_epoch
+            and result.epochs_run % checkpoint_every != 0
+        ):
+            checkpointer.save(result.epochs_run, w, extra=self._sync_ckpt_extra(
+                test_newest_first, opt_kind, opt_state))
 
         result.state = GradState(
             weights=w, loss=result.losses[-1] if result.losses else float("nan")
         ).finish()
         return result
+
+    def _sync_ckpt_extra(self, test_newest_first, opt_kind: str, opt_state):
+        """Shared snapshot contract (checkpoint.sync_fit_extra): mesh and
+        RPC sync checkpoints stay interchangeable."""
+        from distributed_sgd_tpu.checkpoint import sync_fit_extra
+
+        leaves = jax.tree_util.tree_leaves(opt_state) if opt_state is not None else []
+        return sync_fit_extra(test_newest_first, opt_kind, leaves)
 
     # -- async fit (MasterAsync.scala:32-162) ------------------------------
 

@@ -70,13 +70,9 @@ class SyncTrainer:
             kernel=kernel, virtual_workers=virtual_workers,
             optimizer=optimizer, momentum=momentum,
         )
-        # checkpoint tag for structural resume validation: string-configured
-        # optimizers validate by name; arbitrary optax transformations all
-        # tag 'custom' (their identity is not recoverable from a string)
-        self._opt_kind = (
-            optimizer if isinstance(optimizer, str)
-            else ("sgd" if optimizer is None else "custom")
-        )
+        from distributed_sgd_tpu.checkpoint import opt_kind_tag
+
+        self._opt_kind = opt_kind_tag(optimizer)
         self.model = model
         self.metrics = metrics or metrics_mod.global_metrics()
         self.seed = seed
@@ -107,50 +103,33 @@ class SyncTrainer:
         if self.checkpointer is not None:
             restored = self.checkpointer.restore_latest()
             if restored is not None:
+                from distributed_sgd_tpu.checkpoint import decode_sync_fit_state
+
                 start_epoch, state = restored
                 w = jnp.asarray(state["weights"])
                 # early-stopping continuity: the criterion sees the full
-                # newest-first test-loss history, not just post-resume epochs
-                if "test_losses_nf" in state:
-                    test_losses_newest_first = [
-                        float(x) for x in np.asarray(state["test_losses_nf"])
-                    ]
-                # optimizer continuity: momentum/adam buffers resume where
-                # they left off (a zeroed adam state on converged weights
-                # would bias-correct into a large first step).  Refuse a
-                # checkpoint written under a different optimizer kind, leaf
-                # count, or leaf shape (e.g. a kernel-layout change) rather
-                # than silently resuming with zeroed or misassembled state
-                saved_kind = (
-                    bytes(np.asarray(state["opt_kind"], np.uint8)).decode()
-                    if "opt_kind" in state else "sgd"
+                # newest-first test-loss history; optimizer continuity:
+                # momentum/adam buffers resume where they left off (a zeroed
+                # adam state on converged weights would bias-correct into a
+                # large first step).  Kind/shape mismatches raise (shared
+                # contract, checkpoint.decode_sync_fit_state)
+                test_losses_newest_first, opt_leaves = decode_sync_fit_state(
+                    state, self._opt_kind, bound_train.opt_state_leaves()
                 )
-                if saved_kind != self._opt_kind:
-                    raise ValueError(
-                        f"checkpoint was written with optimizer "
-                        f"{saved_kind!r} but this run is configured with "
-                        f"{self._opt_kind!r}; resume with the original "
-                        f"optimizer or point at a fresh checkpoint_dir"
-                    )
-                opt_leaves = []
-                while f"opt_{len(opt_leaves)}" in state:
-                    opt_leaves.append(state[f"opt_{len(opt_leaves)}"])
-                expected = bound_train.opt_state_leaves()
-                shapes_ok = len(opt_leaves) == len(expected) and all(
-                    np.shape(g) == np.shape(e) for g, e in zip(opt_leaves, expected)
-                )
-                if not shapes_ok:
-                    raise ValueError(
-                        f"checkpointed optimizer-state leaves "
-                        f"{[np.shape(x) for x in opt_leaves]} do not match the "
-                        f"configured optimizer/kernel layout "
-                        f"{[np.shape(x) for x in expected]}; resume with the "
-                        f"original optimizer and kernel, or use a fresh "
-                        f"checkpoint_dir"
-                    )
                 if opt_leaves:
                     bound_train.load_opt_state_leaves(opt_leaves)
                 log.info("resumed from checkpoint at epoch %d", start_epoch)
+
+        if start_epoch >= max_epochs:
+            # a resumed run that is already done must not report epochs_run=0
+            # with a NaN loss (ADVICE r2): evaluate the restored weights
+            loss, acc = bound_train.evaluate(w)
+            log.info(
+                "checkpoint already at epoch %d >= max_epochs %d: nothing to "
+                "run (loss=%.6f acc=%.4f)", start_epoch, max_epochs, loss, acc)
+            result.epochs_run = start_epoch
+            result.state = GradState(weights=w, loss=loss).finish()
+            return result
 
         # prefer the second epoch (steady-state, compile excluded) but fall
         # back to the only epoch when the fit runs just one
@@ -223,13 +202,11 @@ class SyncTrainer:
         return result
 
     def _ckpt_extra(self, test_losses_newest_first: List[float], bound):
-        extra = {}
-        if test_losses_newest_first:
-            extra["test_losses_nf"] = np.asarray(test_losses_newest_first, np.float32)
-        extra["opt_kind"] = np.frombuffer(self._opt_kind.encode(), dtype=np.uint8)
-        for i, leaf in enumerate(bound.opt_state_leaves()):
-            extra[f"opt_{i}"] = np.asarray(leaf)
-        return extra
+        from distributed_sgd_tpu.checkpoint import sync_fit_extra
+
+        return sync_fit_extra(
+            test_losses_newest_first, self._opt_kind, bound.opt_state_leaves()
+        )
 
     def predict(self, weights: jax.Array, data: Dataset):
         """Predictions over a split (Master.predict, Master.scala:61-75)."""

@@ -195,21 +195,21 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
+        ckpt = _make_checkpointer(cfg)
         if cfg.use_async:
-            ckpt = _make_checkpointer(cfg)
             res = c.master.fit_async(
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
                 initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
             )
-            saved = ckpt is not None
         else:
             res = c.master.fit_sync(
-                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion
+                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
+                checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
+                optimizer=cfg.optimizer, momentum=cfg.momentum,
             )
-            saved = False
         _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True),
-                saved=saved)
+                saved=ckpt is not None)
 
 
 def _finish(cfg: Config, res, evaluator=None, saved: bool = False) -> None:
@@ -221,8 +221,9 @@ def _finish(cfg: Config, res, evaluator=None, saved: bool = False) -> None:
     else:
         tl, ta = evaluator(np.asarray(w))
         log.info("final test loss=%.6f acc=%.4f", tl, ta)
-    # exit-time snapshot for paths without in-fit checkpoint wiring (the
-    # RPC scenario's sync fit); wired paths already saved during the fit
+    # safety net: every scenario path now wires its checkpointer into the
+    # fit itself (mesh + RPC, sync + async), so this exit-time snapshot only
+    # runs for future paths added without in-fit wiring
     if cfg.checkpoint_dir and not saved:
         from distributed_sgd_tpu.checkpoint import Checkpointer
 
@@ -260,19 +261,21 @@ def main() -> None:
         ).start(heartbeat_s=cfg.heartbeat_s)
         criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
         master.await_ready()
+        ckpt = _make_checkpointer(cfg)
         if cfg.use_async:
-            ckpt = _make_checkpointer(cfg)
             res = master.fit_async(
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
                 initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
             )
-            saved = ckpt is not None
         else:
-            res = master.fit_sync(cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion)
-            saved = False
+            res = master.fit_sync(
+                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
+                checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
+                optimizer=cfg.optimizer, momentum=cfg.momentum,
+            )
         _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True),
-                saved=saved)
+                saved=ckpt is not None)
         master.stop()
     else:  # worker
         from distributed_sgd_tpu.core.worker import WorkerNode

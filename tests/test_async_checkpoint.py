@@ -294,3 +294,20 @@ def test_select_topology(node_count, n_dev, use_async, exact, want):
 
     assert select_topology(node_count, n_dev, use_async,
                            exact_topology=exact) == want
+
+
+def test_divergent_run_never_persists_nan_weights(tmp_path):
+    """A run whose losses are never finite must not checkpoint at all: the
+    cadence save used to persist the CURRENT (divergent) weights with
+    best_loss=inf (ADVICE r2), which a resumed run then adopted as best."""
+    ckpt = Checkpointer(str(tmp_path / "ck"))
+    checker = LossChecker(1.0, checkpointer=ckpt, save_every=2)
+    bad = np.full(4, np.nan, dtype=np.float32)
+    for step in range(6):
+        checker.check(float("nan"), 0.0, bad, step=step)
+    assert checker.best_weights is None
+    assert ckpt.latest_step() is None  # nothing saved
+    # (a later finite raw loss cannot rescue this run: the leaky smoothing
+    # chain is NaN-poisoned — (1-c)*NaN — matching the reference formula,
+    # MasterAsync.scala:122-125; recovery is a fresh run, which existing
+    # tests cover)

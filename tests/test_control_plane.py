@@ -164,3 +164,96 @@ def test_rpc_loss_matches_mesh_all_models(data, model_name):
         _preds, margins = c.master.predict(w, return_margins=True)
         assert margins.shape == (len(train),)
         assert not np.all(margins == 0.0)
+
+
+def test_sync_fit_rpc_checkpoint_resume(data, tmp_path):
+    """RPC sync fit saves at epoch cadence and resumes (VERDICT r2 item 2:
+    symmetry with SyncTrainer's checkpoint wiring, core/trainer.py)."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    train, test = data
+    ck_dir = str(tmp_path / "ck")
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        res1 = c.master.fit_sync(
+            max_epochs=2, batch_size=16, learning_rate=0.5,
+            checkpointer=Checkpointer(ck_dir),
+        )
+        assert res1.epochs_run == 2
+        ck = Checkpointer(ck_dir)
+        assert ck.latest_step() == 2
+        res2 = c.master.fit_sync(
+            max_epochs=4, batch_size=16, learning_rate=0.5,
+            checkpointer=ck,
+        )
+        # resumed: only epochs 2..3 ran, history continues from the snapshot
+        assert res2.epochs_run == 4
+        assert len(res2.losses) == 2
+        assert ck.latest_step() == 4
+        # the resumed run continues from res1's weights, not from w0
+        assert not np.allclose(np.asarray(res2.state.weights), 0.0)
+
+
+def test_sync_fit_rpc_resume_past_max_epochs(data, tmp_path):
+    """Resuming at/past max_epochs runs zero epochs but reports the
+    restored state with a real evaluated loss (ADVICE r2: trainer.py:209
+    class of bug, fixed on both sync paths)."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    train, test = data
+    ck_dir = str(tmp_path / "ck")
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        c.master.fit_sync(max_epochs=2, batch_size=16, learning_rate=0.5,
+                          checkpointer=Checkpointer(ck_dir))
+        res = c.master.fit_sync(max_epochs=2, batch_size=16, learning_rate=0.5,
+                                checkpointer=Checkpointer(ck_dir))
+        assert res.epochs_run == 2
+        assert np.isfinite(res.state.loss)
+
+
+def test_sync_fit_rpc_momentum_optimizer(data, tmp_path):
+    """DSGD_OPTIMIZER reaches the RPC sync fit (VERDICT r2 item 3): the
+    momentum trajectory diverges from plain SGD, optimizer state is
+    checkpointed, and a mismatched resume fails fast."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        res_sgd = c.master.fit_sync(max_epochs=1, batch_size=16, learning_rate=0.1)
+        ck_dir = str(tmp_path / "ck_mom")
+        res_mom = c.master.fit_sync(
+            max_epochs=1, batch_size=16, learning_rate=0.1,
+            optimizer="momentum", checkpointer=Checkpointer(ck_dir),
+        )
+        assert not np.allclose(
+            np.asarray(res_sgd.state.weights), np.asarray(res_mom.state.weights)
+        )
+        # momentum leaves persisted alongside the weights
+        _, state = Checkpointer(ck_dir).restore_latest()
+        assert "opt_0" in state and np.shape(state["opt_0"]) == (128,)
+        with pytest.raises(ValueError, match="optimizer"):
+            c.master.fit_sync(
+                max_epochs=2, batch_size=16, learning_rate=0.1,
+                optimizer="adam", checkpointer=Checkpointer(ck_dir),
+            )
+
+
+def test_rpc_checkpoint_resumes_in_mesh_trainer(data, tmp_path):
+    """Mesh and RPC sync checkpoints share state keys: a snapshot written
+    by MasterNode.fit_sync resumes in SyncTrainer (plain SGD)."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.core.trainer import SyncTrainer
+    from distributed_sgd_tpu.parallel.mesh import make_mesh
+
+    train, test = data
+    ck_dir = str(tmp_path / "ck_x")
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        res1 = c.master.fit_sync(max_epochs=1, batch_size=16, learning_rate=0.5,
+                                 checkpointer=Checkpointer(ck_dir))
+    trainer = SyncTrainer(
+        _model(), make_mesh(2), batch_size=16, learning_rate=0.5,
+        checkpointer=Checkpointer(ck_dir),
+    )
+    res2 = trainer.fit(train, test, max_epochs=2)
+    assert res2.epochs_run == 2 and len(res2.losses) == 1
+    assert np.isfinite(res2.state.loss)
+    del res1

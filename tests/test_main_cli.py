@@ -81,3 +81,20 @@ def test_invalid_config_fails_fast(tmp_path):
     )
     assert proc.returncode != 0
     assert "kernel" in (proc.stdout + proc.stderr)
+
+
+def test_dev_rpc_sync_checkpoint_resume(tmp_path):
+    """DSGD_ENGINE=rpc sync saves at epoch cadence and a re-run resumes —
+    symmetry with test_dev_mesh_sync_with_checkpoint (VERDICT r2 item 2)."""
+    ck = str(tmp_path / "ck")
+    out = run_main(tmp_path, {"DSGD_ENGINE": "rpc", "DSGD_CHECKPOINT_DIR": ck})
+    assert "checkpoint saved" in out
+    out2 = run_main(tmp_path, {
+        "DSGD_ENGINE": "rpc", "DSGD_CHECKPOINT_DIR": ck, "DSGD_MAX_EPOCHS": "2",
+    })
+    assert "resumed sync fit from checkpoint" in out2
+    # a third run already at max_epochs runs nothing but reports real state
+    out3 = run_main(tmp_path, {
+        "DSGD_ENGINE": "rpc", "DSGD_CHECKPOINT_DIR": ck, "DSGD_MAX_EPOCHS": "2",
+    })
+    assert "nothing to run" in out3
