@@ -582,7 +582,21 @@ class MasterNode:
         split: SplitFn = vanilla_split,
         initial_weights: Optional[np.ndarray] = None,
         checkpointer=None,
+        optimizer: Optional[str] = None,
+        momentum: float = 0.9,
     ) -> FitResult:
+        if optimizer is not None and not isinstance(optimizer, str):
+            raise ValueError(
+                "the RPC topology ships the optimizer by NAME in "
+                "StartAsyncRequest; pass 'sgd'/'momentum'/'adam' (an optax "
+                "transform object cannot cross the wire)"
+            )
+        from distributed_sgd_tpu.parallel.sync import resolve_optimizer
+
+        # dry-run the resolution so an unknown name fails HERE, before any
+        # worker is started (a mid-fan-out failure would leave early
+        # workers gossiping and _async_running permanently set)
+        resolve_optimizer(optimizer, learning_rate, momentum)
         self._require_ready()
         if self._async_running.is_set():
             raise RuntimeError("a computation is already running")  # MasterAsync.scala:42
@@ -608,6 +622,8 @@ class MasterNode:
                     samples=part.astype(np.int32),
                     batch_size=batch_size,
                     learning_rate=learning_rate,
+                    optimizer=optimizer or "",
+                    momentum=momentum,
                 ),
                 timeout=10.0,
             )

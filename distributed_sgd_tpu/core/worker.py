@@ -226,7 +226,8 @@ class WorkerNode:
     # -- async engine (Slave.scala:79-111,159-195) -------------------------
 
     def start_async(self, w0: np.ndarray, assignment: np.ndarray, batch_size: int,
-                    learning_rate: float) -> None:
+                    learning_rate: float, optimizer: str = "",
+                    momentum: float = 0.9) -> None:
         with self._w_lock:
             self._w = jax.device_put(jnp.asarray(w0, dtype=jnp.float32), self.device)
         self._assignment = jax.device_put(
@@ -234,13 +235,24 @@ class WorkerNode:
         )
         self._async_bs = int(batch_size)
         self._async_lr = float(learning_rate)
+        # optimizer for the LOCAL steps (StartAsyncRequest.optimizer;
+        # ""/sgd = the reference's plain update, Slave.scala:99-101) —
+        # resolved HERE so an unknown name fails the StartAsync RPC
+        # instead of killing the daemon loop thread
+        from distributed_sgd_tpu.parallel.sync import resolve_optimizer
+
+        # momentum passes through verbatim — an explicit 0.0 is honored
+        # (the master always sets both proto fields; when optimizer is
+        # absent/sgd the value is unused anyway)
+        self._async_opt = resolve_optimizer(
+            optimizer or None, float(learning_rate), float(momentum))
         self._running_async.set()
         self._async_thread = threading.Thread(
             target=self._async_loop, daemon=True, name=f"async-{self.port}"
         )
         self._async_thread.start()
-        self.log.info("async started: %d samples, bs=%d lr=%g",
-                      len(assignment), batch_size, learning_rate)
+        self.log.info("async started: %d samples, bs=%d lr=%g optimizer=%s",
+                      len(assignment), batch_size, learning_rate, optimizer or "sgd")
 
     def stop_async(self) -> None:
         self._running_async.clear()
@@ -259,32 +271,42 @@ class WorkerNode:
         ksteps = self.steps_per_dispatch
 
         blocked = self._blocked_device()
+        opt = self._async_opt
 
-        def kstep(w, assignment, idx, val, y, key):
+        def kstep(w, opt_state, assignment, idx, val, y, key):
             # k local SGD steps in ONE compiled dispatch; returns the
             # SUMMED delta for gossip (commutative merge — peers applying
             # the sum see exactly the k individual w <- w - delta merges,
-            # just k steps later; staleness bounded by k)
+            # just k steps later; staleness bounded by k).  Optimizer
+            # state is LOCAL and threads through the carry across
+            # dispatches; the wire still carries weight-space deltas
             def body(carry, kk):
-                w_t, acc = carry
+                w_t, opt_s, acc = carry
                 ids = assignment[jax.random.randint(kk, (bs,), 0, n_assigned)]
                 batch = SparseBatch(idx[ids], val[ids])
                 # MEAN reduce (Slave.scala:93-98) + regularize (Slave:99)
-                delta = lr * model.grad_regularized(
+                g = model.grad_regularized(
                     w_t, batch, y[ids], reduce="mean", blocked=blocked
                 )
-                return (w_t - delta, acc + delta), None
+                from distributed_sgd_tpu.parallel.sync import local_update
+
+                w_t, opt_s, delta = local_update(opt, lr, g, w_t, opt_s)
+                return (w_t, opt_s, acc + delta), None
 
             keys = jax.random.split(key, ksteps)
-            (_, acc), _ = jax.lax.scan(body, (w, jnp.zeros_like(w)), keys)
-            return acc
+            (_, opt_state, acc), _ = jax.lax.scan(
+                body, (w, opt_state, jnp.zeros_like(w)), keys)
+            return acc, opt_state
 
         kstep = jax.jit(kstep)
         key = jax.random.PRNGKey(self.seed + self.port)
+        opt_state = opt.init(self._w) if opt is not None else None
         while self._running_async.is_set():
             key, k = jax.random.split(key)
             snapshot = self._w  # stale read is the algorithm
-            delta = kstep(snapshot, self._assignment, self._idx, self._val, self._y, k)
+            delta, opt_state = kstep(
+                snapshot, opt_state, self._assignment, self._idx, self._val,
+                self._y, k)
             with self._w_lock:
                 self._w = self._apply(self._w, delta)
             self.metrics.counter("slave.async.batch").increment(ksteps)
@@ -334,6 +356,8 @@ class _WorkerServicer:
             np.fromiter(request.samples, dtype=np.int64),
             request.batch_size,
             request.learning_rate,
+            optimizer=request.optimizer,
+            momentum=request.momentum,
         )
         return pb.Ack()
 

@@ -156,6 +156,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             learning_rate=cfg.learning_rate, check_every=cfg.check_every,
             leaky_loss=cfg.leaky_loss, seed=cfg.seed, checkpointer=ckpt,
             steps_per_dispatch=cfg.steps_per_dispatch,
+            optimizer=cfg.optimizer, momentum=cfg.momentum,
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion,
                       initial_weights=_restore_weights(ckpt))
@@ -167,6 +168,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             learning_rate=cfg.learning_rate, sync_period=cfg.sync_period,
             check_every=cfg.check_every, leaky_loss=cfg.leaky_loss, seed=cfg.seed,
             kernel=cfg.kernel, checkpointer=ckpt,
+            optimizer=cfg.optimizer, momentum=cfg.momentum,
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion,
                       initial_weights=_restore_weights(ckpt))
@@ -201,6 +203,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
                 initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
+                optimizer=cfg.optimizer, momentum=cfg.momentum,
             )
         else:
             res = c.master.fit_sync(
@@ -267,6 +270,7 @@ def main() -> None:
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
                 initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
+                optimizer=cfg.optimizer, momentum=cfg.momentum,
             )
         else:
             res = master.fit_sync(

@@ -78,6 +78,8 @@ class _Worker:
         metrics: metrics_mod.Metrics,
         max_inbox: int = 1024,
         steps_per_dispatch: int = 1,
+        optimizer=None,
+        momentum: float = 0.9,
     ):
         self.wid = wid
         self.device = device
@@ -105,7 +107,13 @@ class _Worker:
 
         n_features = model.n_features
 
-        def kstep(w, idx, val, y, key):
+        from distributed_sgd_tpu.parallel.sync import resolve_optimizer
+
+        opt = self._opt = resolve_optimizer(optimizer, learning_rate, momentum)
+        self._blocked = blocked
+        self._opt_state = None  # carried across dispatches (set in start_async)
+
+        def kstep(w, opt_state, idx, val, y, key):
             # k local SGD steps in ONE compiled dispatch (lax.scan), each on
             # the locally-updated weights; returns the SUMMED delta for
             # gossip.  Deltas commute (every mutation is a subtraction,
@@ -113,36 +121,43 @@ class _Worker:
             # k individual merges; what changes vs k=1 is only *when* they
             # see them — a bounded staleness period of k local steps, the
             # dispatch-amortization knob for slow transports.  On the MXU
-            # path weights stay in the blocked layout ACROSS the scan —
-            # one to/from conversion per dispatch, not per step (the
-            # pattern of local_sgd.round_shard).
+            # path weights (and optimizer state) stay in the blocked layout
+            # ACROSS the scan — one to/from conversion per dispatch, not per
+            # step (the pattern of local_sgd.round_shard).  With a stateful
+            # optimizer the state is LOCAL to this worker and persists
+            # across dispatches (opt_state threads through the carry); the
+            # gossiped quantity stays a weight-space delta, so merges remain
+            # the commutative subtractions the algorithm needs.
             if blocked:
                 from distributed_sgd_tpu.ops import mxu as _mxu
 
                 w = _mxu.to_blocked(w, n_features)
 
             def body(carry, kk):
-                w_t, acc = carry
+                w_t, opt_s, acc = carry
                 ids = jax.random.randint(kk, (bs,), 0, shard_n)
                 if dense:
                     g = model.grad_dense(w_t, val[ids], y[ids], reduce="mean")
-                    delta = learning_rate * model.regularize(g, w_t)
-                    return (w_t - delta, acc + delta), None
-                batch = SparseBatch(idx[ids], val[ids])
-                # MEAN (Slave.scala:93-98) + regularize (Slave.scala:99)
-                if blocked:
-                    g = model.grad_blocked(w_t, batch, y[ids], reduce="mean")
-                    delta = learning_rate * model.regularize_blocked(g, w_t)
+                    g = model.regularize(g, w_t)
+                elif blocked:
+                    # MEAN (Slave.scala:93-98) + regularize (Slave.scala:99)
+                    g = model.grad_blocked(
+                        w_t, SparseBatch(idx[ids], val[ids]), y[ids], reduce="mean")
+                    g = model.regularize_blocked(g, w_t)
                 else:
-                    g = model.grad_mean(w_t, batch, y[ids])
-                    delta = learning_rate * model.regularize(g, w_t)
-                return (w_t - delta, acc + delta), None
+                    g = model.grad_mean(w_t, SparseBatch(idx[ids], val[ids]), y[ids])
+                    g = model.regularize(g, w_t)
+                from distributed_sgd_tpu.parallel.sync import local_update
+
+                w_t, opt_s, delta = local_update(opt, learning_rate, g, w_t, opt_s)
+                return (w_t, opt_s, acc + delta), None
 
             keys = jax.random.split(key, k)
-            (_, acc), _ = jax.lax.scan(body, (w, jnp.zeros_like(w)), keys)
+            (_, opt_state, acc), _ = jax.lax.scan(
+                body, (w, opt_state, jnp.zeros_like(w)), keys)
             if blocked:
                 acc = _mxu.from_blocked(acc, n_features)
-            return acc
+            return acc, opt_state
 
         self._step = jax.jit(kstep)
         self._apply = jax.jit(lambda w, d: w - d)
@@ -171,6 +186,13 @@ class _Worker:
     def start_async(self, w0: np.ndarray) -> None:
         """StartAsync RPC (Slave.scala:159-175)."""
         self.w = jax.device_put(jnp.asarray(w0, dtype=jnp.float32), self.device)
+        if self._opt is not None:
+            from distributed_sgd_tpu.ops import mxu as _mxu
+
+            model_w = (
+                _mxu.to_blocked(self.w, self.w.shape[0]) if self._blocked else self.w
+            )
+            self._opt_state = self._opt.init(model_w)
         self._running.set()
         self._thread = threading.Thread(target=self._loop, name=f"hogwild-{self.wid}", daemon=True)
         self._thread.start()
@@ -206,7 +228,8 @@ class _Worker:
             self._drain_inbox()
             self._key, k = jax.random.split(self._key)
             snapshot = self.w  # stale-read is the algorithm (Hogwild)
-            delta = self._step(snapshot, self._idx, self._val, self._y, k)
+            delta, self._opt_state = self._step(
+                snapshot, self._opt_state, self._idx, self._val, self._y, k)
             with self._lock:
                 self.w = self._apply(self.w, delta)
             self.metrics.counter("slave.async.batch").increment(self.k)
@@ -235,13 +258,19 @@ class HogwildEngine:
         metrics: Optional[metrics_mod.Metrics] = None,
         steps_per_dispatch: int = 1,
         checkpointer=None,
+        optimizer=None,
+        momentum: float = 0.9,
     ):
         """steps_per_dispatch=k amortizes host dispatch: each worker runs k
         local SGD steps in one compiled program and gossips the summed
         delta every k steps.  k=1 is the reference's per-step gossip
         (Slave.scala:103-105); larger k trades gossip freshness (staleness
         bounded by k local steps) for k× fewer host hops — the difference
-        that matters on slow transports like the tunnel."""
+        that matters on slow transports like the tunnel.
+
+        `optimizer` (None/'sgd' | 'momentum' | 'adam' | optax transform)
+        shapes each worker's LOCAL steps; state never travels — the wire
+        still carries weight-space deltas, so peer merges stay commutative."""
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
         if steps_per_dispatch < 1:
@@ -255,6 +284,8 @@ class HogwildEngine:
         self.backoff_s = backoff_s
         self.steps_per_dispatch = int(steps_per_dispatch)
         self.checkpointer = checkpointer  # persists best weights (LossChecker)
+        self.optimizer = optimizer
+        self.momentum = momentum
         self.seed = seed
         self.metrics = metrics or metrics_mod.global_metrics()
         devs = list(devices if devices is not None else jax.devices())
@@ -312,6 +343,8 @@ class HogwildEngine:
                 self.seed,
                 self.metrics,
                 steps_per_dispatch=self.steps_per_dispatch,
+                optimizer=self.optimizer,
+                momentum=self.momentum,
             )
             for i in range(self.n_workers)
         ]

@@ -57,12 +57,21 @@ class LocalSGDEngine:
         metrics: Optional[metrics_mod.Metrics] = None,
         kernel: str = "mxu",
         checkpointer=None,
+        optimizer=None,
+        momentum: float = 0.9,
     ):
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
         if kernel not in ("mxu", "scalar"):
             raise ValueError(f"kernel must be 'mxu' or 'scalar', got {kernel!r}")
         self.kernel = kernel
+        # optimizer for the replicas' local steps; state rides the scan
+        # carry within a round and, like the weights, is pmean-averaged at
+        # each sync point (float leaves; the standard local-SGD/FedAvg-
+        # with-momentum treatment), so replicas re-diverge from a common
+        # optimizer state each round
+        self.optimizer = optimizer
+        self.momentum = momentum
         self.model = model
         self.mesh = mesh
         self.batch_size = int(batch_size)
@@ -95,34 +104,55 @@ class LocalSGDEngine:
         blocked = self.kernel == "mxu" and not dense
         n_features = model.n_features
 
-        def round_shard(w, idx, val, y, key):
+        from distributed_sgd_tpu.parallel.sync import resolve_optimizer
+
+        opt = resolve_optimizer(self.optimizer, self.learning_rate, self.momentum)
+
+        def round_shard(w, opt_state, idx, val, y, key):
             key = jax.random.fold_in(key, jax.lax.axis_index(AXIS))
             if blocked:
                 w = mxu.to_blocked(w, n_features)
 
-            def body(wl, t):
+            def body(carry, t):
+                wl, opt_s = carry
                 ids = jax.random.randint(jax.random.fold_in(key, t), (bs,), 0, shard_n)
                 if dense:
                     g = model.grad_dense(wl, val[ids], y[ids], reduce="mean")
-                    return wl - lr * model.regularize(g, wl), ()
-                batch = SparseBatch(idx[ids], val[ids])
-                if blocked:
-                    g = model.grad_blocked(wl, batch, y[ids], reduce="mean")
-                    return wl - lr * model.regularize_blocked(g, wl), ()
-                g = model.grad_mean(wl, batch, y[ids])
-                return wl - lr * model.regularize(g, wl), ()
+                    g = model.regularize(g, wl)
+                elif blocked:
+                    g = model.grad_blocked(wl, SparseBatch(idx[ids], val[ids]),
+                                           y[ids], reduce="mean")
+                    g = model.regularize_blocked(g, wl)
+                else:
+                    g = model.grad_mean(wl, SparseBatch(idx[ids], val[ids]), y[ids])
+                    g = model.regularize(g, wl)
+                from distributed_sgd_tpu.parallel.sync import local_update
 
-            w_var = jax.lax.pcast(w, (AXIS,), to="varying")  # replicas diverge
-            wl, _ = jax.lax.scan(body, w_var, jnp.arange(h))
-            wl = jax.lax.pmean(wl, AXIS)  # the gossip, collapsed
-            return mxu.from_blocked(wl, n_features) if blocked else wl
+                wl, opt_s, _delta = local_update(opt, lr, g, wl, opt_s)
+                return (wl, opt_s), ()
+
+            # replicas diverge over the round, then average: weights and
+            # float optimizer leaves via pmean (the gossip, collapsed);
+            # integer leaves (e.g. adam's count) advance identically on
+            # every replica, so pmax just re-asserts their invariance
+            w_var = jax.lax.pcast(w, (AXIS,), to="varying")
+            opt_var = jax.tree.map(
+                lambda x: jax.lax.pcast(x, (AXIS,), to="varying"), opt_state)
+            (wl, opt_state), _ = jax.lax.scan(body, (w_var, opt_var), jnp.arange(h))
+            wl = jax.lax.pmean(wl, AXIS)
+            opt_state = jax.tree.map(
+                lambda x: jax.lax.pmean(x, AXIS)
+                if jnp.issubdtype(x.dtype, jnp.floating) else jax.lax.pmax(x, AXIS),
+                opt_state,
+            )
+            return mxu.from_blocked(wl, n_features) if blocked else wl, opt_state
 
         round_fn = jax.jit(
             jax.shard_map(
                 round_shard,
                 mesh=self.mesh,
-                in_specs=(P(), P(AXIS), P(AXIS), P(AXIS), P()),
-                out_specs=P(),
+                in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P()),
+                out_specs=(P(), P()),
             )
         )
 
@@ -133,6 +163,12 @@ class LocalSGDEngine:
             if initial_weights is None
             else jnp.asarray(initial_weights, dtype=jnp.float32)
         )
+        # optimizer state lives in the kernel's layout (like the weights
+        # inside a round); initialized once, averaged at every sync point
+        opt_state = (
+            opt.init(mxu.to_blocked(w, self.model.n_features) if blocked else w)
+            if opt is not None else None
+        )
         key = jax.random.PRNGKey(self.seed)
         result = FitResult(state=GradState(weights=w))
         checker = LossChecker(self.leaky_loss, criterion, checkpointer=self.checkpointer)
@@ -142,7 +178,8 @@ class LocalSGDEngine:
         while steps_done < max_steps:
             key, rk = jax.random.split(key)
             t0 = time.perf_counter()
-            w = round_fn(w, data.indices, data.values, data.labels, rk)
+            w, opt_state = round_fn(
+                w, opt_state, data.indices, data.values, data.labels, rk)
             jax.block_until_ready(w)
             self.metrics.histogram("slave.async.round.seconds").record(
                 time.perf_counter() - t0

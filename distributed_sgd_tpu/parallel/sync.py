@@ -485,6 +485,22 @@ class BoundSync:
         return reg + loss_sum / n, hit_sum / n
 
 
+def local_update(opt, learning_rate: float, g, w, opt_state):
+    """One local optimizer step, shared by every async scan body
+    (parallel/hogwild.py, parallel/local_sgd.py, core/worker.py).
+
+    Returns (w', opt_state', delta) where delta is the weight-space
+    DECREMENT (w' = w - delta): gossip protocols accumulate and ship delta
+    so peer merges stay the commutative subtractions Hogwild needs
+    (Slave.scala:101,180), regardless of the optimizer.
+    """
+    if opt is None:
+        delta = learning_rate * g  # the reference update (Slave.scala:99)
+        return w - delta, opt_state, delta
+    updates, opt_state = opt.update(g, opt_state, w)
+    return w + updates, opt_state, -updates
+
+
 def resolve_optimizer(optimizer, learning_rate: float, momentum: float = 0.9):
     """None/'sgd' -> None (the reference's plain update, Master.scala:197);
     'momentum'/'adam' -> the optax transformation at `learning_rate`; an

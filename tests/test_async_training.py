@@ -89,8 +89,8 @@ def test_hogwild_kstep_blocked_matches_unblocked(monkeypatch):
     wa, wb = mk(False), mk(True)
     w0 = jnp.zeros(128, dtype=jnp.float32)
     key = jax.random.PRNGKey(3)
-    da = np.asarray(wa._step(w0, wa._idx, wa._val, wa._y, key))
-    db = np.asarray(wb._step(w0, wb._idx, wb._val, wb._y, key))
+    da = np.asarray(wa._step(w0, None, wa._idx, wa._val, wa._y, key)[0])
+    db = np.asarray(wb._step(w0, None, wb._idx, wb._val, wb._y, key)[0])
     assert np.any(da != 0)
     np.testing.assert_allclose(da, db, rtol=1e-5, atol=1e-6)
 

@@ -109,3 +109,85 @@ def test_config_optimizer_fields(monkeypatch):
     assert cfg.optimizer == "momentum" and cfg.momentum == 0.8
     with pytest.raises(ValueError):
         Config(optimizer="bogus")
+
+
+# -- VERDICT r2 item 3: DSGD_OPTIMIZER honest in EVERY engine --------------
+
+
+def test_local_sgd_momentum_changes_trajectory():
+    """LocalSGDEngine threads optax through the replica scan and averages
+    state at sync points: momentum must diverge from plain SGD, and adam's
+    integer count leaf must survive the pmean/pmax averaging."""
+    from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
+
+    data = rcv1_like(96, n_features=64, nnz=6, seed=21)
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+    train, test = train_test_split(data)
+    outs = {}
+    for name in ("sgd", "momentum", "adam"):
+        eng = LocalSGDEngine(
+            make_model("hinge", 1e-4, 64, regularizer="l2"), make_mesh(2),
+            batch_size=8, learning_rate=0.1, sync_period=4, check_every=16,
+            seed=3, optimizer=name,
+        )
+        res = eng.fit(train, test, max_epochs=1)
+        w = np.asarray(res.state.weights)
+        assert np.all(np.isfinite(w)), name
+        outs[name] = w
+    assert not np.allclose(outs["sgd"], outs["momentum"], atol=1e-7)
+    assert not np.allclose(outs["sgd"], outs["adam"], atol=1e-7)
+
+
+def test_hogwild_worker_momentum_state_advances():
+    """The Hogwild worker's optimizer state is local and persists across
+    dispatches (rides the scan carry); the gossiped quantity stays a
+    weight-space delta."""
+    from distributed_sgd_tpu.parallel.hogwild import _Worker
+    from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+    data = rcv1_like(64, n_features=64, nnz=6, seed=22)
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+    w = _Worker(
+        0, model, data, jax.devices()[0], batch_size=8, learning_rate=0.1,
+        seed=0, metrics=metrics_mod.Metrics(), steps_per_dispatch=4,
+        optimizer="momentum",
+    )
+    w0 = np.zeros(64, np.float32)
+    w.start_async(w0)
+    import time as _time
+
+    deadline = _time.time() + 20
+    while _time.time() < deadline:
+        if w._t >= 8:  # at least two dispatches
+            break
+        _time.sleep(0.05)
+    w.stop_async()
+    w.join()
+    assert w._t >= 8
+    leaves = jax.tree.leaves(w._opt_state)
+    assert any(np.any(np.asarray(x) != 0) for x in leaves if hasattr(x, "shape"))
+    assert not np.allclose(np.asarray(w.w), w0)
+
+
+def test_rpc_async_momentum_and_wire_field():
+    """fit_async ships the optimizer by name in StartAsyncRequest; the
+    worker's local steps use it.  An optax object is rejected fast."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+    train, test = train_test_split(rcv1_like(160, n_features=64, nnz=6, seed=23))
+    model = make_model("hinge", 1e-4, 64, regularizer="l2")
+    with DevCluster(model, train, test, n_workers=2,
+                    steps_per_dispatch=8) as c:
+        res = c.master.fit_async(
+            max_epochs=2, batch_size=8, learning_rate=0.1,
+            check_every=16, optimizer="momentum",
+        )
+        assert np.all(np.isfinite(np.asarray(res.state.weights)))
+        assert res.state.updates > 0
+        with pytest.raises(ValueError, match="wire"):
+            c.master.fit_async(
+                max_epochs=1, batch_size=8, learning_rate=0.1,
+                optimizer=optax.sgd(0.1),
+            )
