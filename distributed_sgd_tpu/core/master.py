@@ -194,12 +194,19 @@ class MasterNode:
     # -- membership (Master.scala:222-253) ---------------------------------
 
     def register_worker(self, host: str, port: int) -> None:
+        """Join-cap semantics: at most `expected_workers` members at any
+        instant (the reference `require`s the same cap, Master.scala:224),
+        but the cap is on CURRENT membership, not lifetime joins — an
+        eviction (heartbeat, Gradient/Forward failure, graceful leave)
+        frees a slot, so a restarted worker re-registers and a running
+        fit_sync absorbs it at its next batch via the live-membership
+        re-split (elastic grow-back up to the configured cluster size;
+        tests/test_fault_tolerance.py::test_worker_rejoins_mid_fit)."""
         key = (host, port)
         with self._members_lock:
             if key in self._workers:
                 return
             if len(self._workers) >= self.expected_workers:
-                # the reference `require`s joins <= expected (Master.scala:224)
                 raise ValueError("cluster already at expected node count")
             others = list(self._workers.keys())
             ch = new_channel(host, port)

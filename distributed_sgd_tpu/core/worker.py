@@ -30,6 +30,7 @@ from distributed_sgd_tpu.models.linear import LinearModel
 from distributed_sgd_tpu.ops.sparse import SparseBatch
 from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
 from distributed_sgd_tpu.rpc.service import (
+    GossipSender,
     MasterStub,
     WorkerStub,
     add_worker_servicer,
@@ -60,6 +61,7 @@ class WorkerNode:
         seed: int = 0,
         metrics: Optional[metrics_mod.Metrics] = None,
         steps_per_dispatch: int = 1,
+        max_inflight_gossip: int = 64,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -81,9 +83,17 @@ class WorkerNode:
         self._n = len(data)
 
         self._peers: Dict[Tuple[str, int], WorkerStub] = {}
+        # bounded fire-and-forget gossip per peer (and to the master):
+        # drop-oldest over max_inflight_gossip in-flight UpdateGrads, drops
+        # counted under slave.async.grad.dropped (parity with the
+        # in-process engine's bounded inbox, parallel/hogwild.py)
+        self._gossip: Dict[Tuple[str, int], GossipSender] = {}
+        self._max_inflight_gossip = int(max_inflight_gossip)
         self._peers_lock = threading.Lock()
         self._master_channel = new_channel(master_host, master_port)
         self._master = MasterStub(self._master_channel)
+        self._master_gossip = GossipSender(
+            self._master.UpdateGrad, self.metrics, self._max_inflight_gossip)
 
         # async (Hogwild) state — Slave.scala:23-34
         self._w_lock = threading.Lock()
@@ -139,6 +149,11 @@ class WorkerNode:
                 )
             except grpc.RpcError:
                 pass
+        with self._peers_lock:
+            senders = list(self._gossip.values())
+        for sender in senders:
+            sender.close()
+        self._master_gossip.close()
         self.server.stop(grace=1.0)
         self._master_channel.close()
         self.log.info("worker stopped")
@@ -154,12 +169,18 @@ class WorkerNode:
             return
         with self._peers_lock:
             if key not in self._peers:
-                self._peers[key] = WorkerStub(new_channel(host, port))
+                stub = WorkerStub(new_channel(host, port))
+                self._peers[key] = stub
+                self._gossip[key] = GossipSender(
+                    stub.UpdateGrad, self.metrics, self._max_inflight_gossip)
                 self.log.info("peer added: %s:%d", host, port)
 
     def remove_peer(self, host: str, port: int) -> None:
         with self._peers_lock:
             self._peers.pop((host, port), None)
+            sender = self._gossip.pop((host, port), None)
+        if sender is not None:
+            sender.close()
 
     # -- compiled kernels --------------------------------------------------
 
@@ -313,10 +334,10 @@ class WorkerNode:
             msg = codec.encode_grad(np.asarray(delta))
             msg.n_steps = ksteps
             with self._peers_lock:
-                peers = list(self._peers.values())
-            for peer in peers:  # fire-and-forget (Slave.scala:103-105)
-                peer.UpdateGrad.future(msg)
-            self._master.UpdateGrad.future(msg)
+                senders = list(self._gossip.values())
+            for sender in senders:  # fire-and-forget (Slave.scala:103-105),
+                sender.send(msg)    # bounded in-flight, drop-oldest
+            self._master_gossip.send(msg)
 
 
 class _WorkerServicer:

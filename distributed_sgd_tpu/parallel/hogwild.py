@@ -105,7 +105,7 @@ class _Worker:
 
         k = self.k
 
-        n_features = model.n_features
+        n_features = self._n_features = model.n_features
 
         from distributed_sgd_tpu.parallel.sync import resolve_optimizer
 
@@ -189,8 +189,11 @@ class _Worker:
         if self._opt is not None:
             from distributed_sgd_tpu.ops import mxu as _mxu
 
+            # same layout derivation as kstep (n_features, not len(w0)):
+            # the state must mirror the scan carry's structure exactly
             model_w = (
-                _mxu.to_blocked(self.w, self.w.shape[0]) if self._blocked else self.w
+                _mxu.to_blocked(self.w, self._n_features)
+                if self._blocked else self.w
             )
             self._opt_state = self._opt.init(model_w)
         self._running.set()

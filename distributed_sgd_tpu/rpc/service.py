@@ -78,6 +78,61 @@ class WorkerStub(_Stub):
         super().__init__(channel, "dsgd.Worker", _WORKER_METHODS)
 
 
+class GossipSender:
+    """Bounded fire-and-forget sender for async delta gossip.
+
+    The reference gossips with no delivery guarantee (fire-and-forget gRPC,
+    Slave.scala:103-105); a naive `.future(msg)` translation accumulates
+    unbounded in-flight RPCs against a slow or wedged peer.  This keeps at
+    most `max_inflight` outstanding UpdateGrad calls per peer: completed
+    futures are pruned on every send, and when the window is still full the
+    OLDEST in-flight call is cancelled and counted under
+    `slave.async.grad.dropped` — the same drop-oldest-under-overload policy
+    as the in-process engine's bounded inbox (parallel/hogwild.py).
+    """
+
+    def __init__(self, call, metrics=None, max_inflight: int = 64):
+        import threading
+
+        self._call = call  # e.g. stub.UpdateGrad
+        self._metrics = metrics
+        self.max_inflight = max(1, int(max_inflight))
+        self._inflight: list = []
+        # close() may run on a gRPC servicer thread (peer unregistered)
+        # while the async loop still holds a snapshot of this sender: the
+        # lock + closed flag stop a late send() from re-populating the
+        # window with a future nobody would ever cancel
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def send(self, msg) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._inflight = [f for f in self._inflight if not f.done()]
+            while len(self._inflight) >= self.max_inflight:
+                old = self._inflight.pop(0)
+                old.cancel()  # best-effort; the delta is lost, as the wire allows
+                if self._metrics is not None:
+                    self._metrics.counter("slave.async.grad.dropped").increment()
+            try:
+                self._inflight.append(self._call.future(msg))
+            except ValueError:  # channel closed under us
+                pass
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return sum(1 for f in self._inflight if not f.done())
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for f in self._inflight:
+                f.cancel()
+            self._inflight.clear()
+
+
 def new_server(port: int, host: str = "0.0.0.0", max_workers: int = 16) -> grpc.Server:
     """Plaintext server factory (core/package.scala:16-17). Port 0 picks a
     free port; the bound port is stored on `server.bound_port`."""

@@ -136,3 +136,74 @@ def test_heartbeat_eviction_then_fit(data):
         )
         assert res.epochs_run == 2
         assert np.isfinite(res.losses[-1])
+
+
+def test_worker_rejoins_mid_fit(data):
+    """Elastic grow-back (VERDICT r2 item 4): a worker dies mid-fit and is
+    evicted; a replacement registers while fit_sync is still running; the
+    live-membership re-split absorbs it and the newcomer serves Gradient
+    calls.  The join cap is on CURRENT membership (eviction frees a slot)
+    — see MasterNode.register_worker."""
+    import jax
+
+    from distributed_sgd_tpu.core.worker import WorkerNode
+
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=3, heartbeat_s=0.2) as c:
+        # slow surviving workers slightly so the fit outlives the rejoin
+        for wk in c.workers[1:]:
+            orig = wk.compute_gradient
+
+            def slowed(w, ids, _orig=orig):
+                time.sleep(0.02)
+                return _orig(w, ids)
+
+            wk.compute_gradient = slowed
+
+        gone = c.workers[0]
+        first_call = threading.Event()
+        orig0 = gone.compute_gradient
+        gone.compute_gradient = lambda w, ids: (first_call.set(), orig0(w, ids))[1]
+
+        box = {}
+
+        def run():
+            try:
+                box["result"] = c.master.fit_sync(
+                    max_epochs=10, batch_size=16, learning_rate=0.5,
+                    grad_timeout_s=5.0,
+                )
+            except Exception as e:  # noqa: BLE001
+                box["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert first_call.wait(30), "fit never reached a worker"
+        _hard_kill(gone)
+
+        deadline = time.time() + 20
+        while time.time() < deadline and len(c.master._workers) > 2:
+            time.sleep(0.05)
+        assert len(c.master._workers) == 2, "eviction never happened"
+        assert t.is_alive(), "fit finished before the rejoin could happen"
+
+        # the restarted worker takes the freed slot mid-fit
+        replacement = WorkerNode(
+            "127.0.0.1", 0, "127.0.0.1", c.master.port, train, _model(),
+            device=jax.devices()[0], seed=99,
+        )
+        served = threading.Event()
+        orig_r = replacement.compute_gradient
+        replacement.compute_gradient = lambda w, ids: (served.set(), orig_r(w, ids))[1]
+        try:
+            replacement.start(wait_registered=True)
+            assert len(c.master._workers) == 3
+            assert served.wait(60), "rejoined worker never served a Gradient"
+            t.join(timeout=120)
+            assert not t.is_alive(), "fit_sync hung after grow-back"
+            assert "error" not in box, f"fit raised: {box.get('error')}"
+            res = box["result"]
+            assert res.epochs_run == 10
+            assert np.isfinite(res.losses[-1])
+        finally:
+            replacement.stop()
