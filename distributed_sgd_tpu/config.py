@@ -61,6 +61,10 @@ class Config:
     checkpoint_every: int = 1  # sync-trainer epoch cadence
     heartbeat_s: Optional[float] = None  # master worker-failure detection period
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
+    # InfluxDB write endpoint for the push reporter (reference parity:
+    # Kamon InfluxDBReporter, application.conf:54-78), e.g.
+    # http://influxdb:8086/write?db=dsgd — active when record=true
+    influx_url: Optional[str] = None
     profile_dir: Optional[str] = None  # jax.profiler trace output
     pad_width: Optional[int] = None  # sparse-batch nnz padding (None = auto)
     kernel: str = "mxu"  # mxu | scalar (sync-engine sparse kernels)
@@ -142,6 +146,7 @@ class Config:
             checkpoint_every=_env("DSGD_CHECKPOINT_EVERY", cls.checkpoint_every, int),
             heartbeat_s=_env("DSGD_HEARTBEAT_S", None, float),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
+            influx_url=_env("DSGD_INFLUX_URL", None, str),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),
             pad_width=_env("DSGD_PAD_WIDTH", None, int),
             kernel=_env("DSGD_KERNEL", cls.kernel, str),

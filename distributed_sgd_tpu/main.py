@@ -240,12 +240,28 @@ def main() -> None:
     log.info("config: %s", cfg.to_json())
     np.random.seed(cfg.seed)  # Main.scala:32 Random.setSeed(0)
 
+    # record=true enables metric SHIPPING (the reference's Kamon reporter
+    # flag, Main.scala:40-43); the transports are orthogonal and may both
+    # run: DSGD_METRICS_PORT serves Prometheus pull, DSGD_INFLUX_URL pushes
+    # line protocol every second (reference parity, application.conf:54-78)
     exporter = None
-    if cfg.record and cfg.metrics_port is not None:
-        from distributed_sgd_tpu.utils.metrics import PrometheusExporter
+    pusher = None
+    if cfg.record:
+        if cfg.metrics_port is not None:
+            from distributed_sgd_tpu.utils.metrics import PrometheusExporter
 
-        exporter = PrometheusExporter(metrics_mod.global_metrics(), cfg.metrics_port).start()
-        log.info("metrics exporter on :%d", exporter.port)
+            exporter = PrometheusExporter(
+                metrics_mod.global_metrics(), cfg.metrics_port).start()
+            log.info("metrics exporter on :%d", exporter.port)
+        if cfg.influx_url:
+            from distributed_sgd_tpu.utils.metrics import InfluxPusher
+
+            pusher = InfluxPusher(metrics_mod.global_metrics(), cfg.influx_url).start()
+            log.info("influx pusher -> %s", cfg.influx_url)
+        if exporter is None and pusher is None:
+            log.warning(
+                "DSGD_RECORD=1 but neither DSGD_METRICS_PORT nor "
+                "DSGD_INFLUX_URL is set: metrics are collected but not shipped")
 
     role = cfg.role
     if role == "dev":
@@ -293,6 +309,8 @@ def main() -> None:
 
     if exporter is not None:
         exporter.stop()
+    if pusher is not None:
+        pusher.stop()
 
 
 if __name__ == "__main__":

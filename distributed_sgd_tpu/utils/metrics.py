@@ -11,9 +11,10 @@ This module provides the same instrument names through a thread-safe
 registry, plus two exporters:
 
 - `PrometheusExporter`: an HTTP endpoint serving the text exposition format
-  (the modern k8s-native replacement for the Kamon->InfluxDB push path).
-- `influx_lines()`: InfluxDB line-protocol rendering for push-based setups,
-  matching the reference's InfluxDBReporter output shape.
+  (the modern k8s-native pull path; DSGD_METRICS_PORT).
+- `InfluxPusher`: a background loop POSTing `influx_lines()` (line
+  protocol) to an InfluxDB write endpoint every second — the reference's
+  `record=true` push behavior (DSGD_INFLUX_URL).
 """
 
 from __future__ import annotations
@@ -208,3 +209,66 @@ class PrometheusExporter:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+
+class InfluxPusher:
+    """Background InfluxDB line-protocol pusher — the reference's
+    `record=true` behavior (Kamon InfluxDBReporter: 1 s tick shipping to
+    influxdb:8086, Main.scala:40-43 + application.conf:54-78).
+
+    POSTs `Metrics.influx_lines()` to `url` (an InfluxDB write endpoint,
+    e.g. ``http://influxdb:8086/write?db=dsgd``) every `interval_s`.
+    Push failures never raise into training: they are counted under
+    `metrics.push.errors` and logged once per failure streak.
+    """
+
+    def __init__(self, metrics: Metrics, url: str, interval_s: float = 1.0,
+                 timeout_s: float = 2.0):
+        self.metrics = metrics
+        self.url = url
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="influx-push")
+        self._failing = False
+
+    def push_once(self) -> bool:
+        """One push; returns True on success (separated for tests)."""
+        import logging
+        import urllib.request
+
+        body = self.metrics.influx_lines().encode()
+        if not body:
+            return True
+        try:
+            req = urllib.request.Request(
+                self.url, data=body, method="POST",
+                headers={"Content-Type": "text/plain; charset=utf-8"},
+            )
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+                ok = 200 <= resp.status < 300
+        except Exception as e:  # noqa: BLE001 - shipping must never kill training
+            self.metrics.counter("metrics.push.errors").increment()
+            if not self._failing:
+                logging.getLogger("dsgd.metrics").warning(
+                    "influx push to %s failing (%s); will keep retrying "
+                    "silently", self.url, e)
+                self._failing = True
+            return False
+        if ok:
+            self._failing = False
+        return ok
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.push_once()
+
+    def start(self) -> "InfluxPusher":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self.timeout_s + self.interval_s)
+        self.push_once()  # final flush, best-effort

@@ -80,3 +80,57 @@ def test_metrics_counters_histograms_exporters():
     assert "slave_async_backward" in text and 'node="slave-1:4001"' in text
     lines = m.influx_lines(ts_ns=123)
     assert "master.sync.loss" in lines and lines.strip().endswith("123")
+
+
+def test_influx_pusher_ships_line_protocol():
+    """DSGD_RECORD + DSGD_INFLUX_URL actively ship metrics (reference
+    parity: Kamon InfluxDBReporter 1 s tick, application.conf:54-78);
+    failures are counted, never raised (VERDICT r2 item 8)."""
+    import http.server
+    import threading
+
+    from distributed_sgd_tpu.utils.metrics import InfluxPusher, Metrics
+
+    received = []
+
+    class Sink(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):  # noqa: N802
+            n = int(self.headers.get("Content-Length", 0))
+            received.append(self.rfile.read(n).decode())
+            self.send_response(204)
+            self.end_headers()
+
+        def log_message(self, *args):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        m = Metrics(tags={"node": "w0"})
+        m.counter("slave.async.batch").increment(5)
+        m.histogram("master.sync.loss").record(0.25)
+        pusher = InfluxPusher(
+            m, f"http://127.0.0.1:{srv.server_address[1]}/write?db=dsgd")
+        assert pusher.push_once()
+        body = received[-1]
+        assert "slave.async.batch,node=w0 value=5i" in body
+        assert "master.sync.loss,node=w0 count=1i" in body
+
+        # a dead endpoint: counted, not raised
+        bad = InfluxPusher(m, "http://127.0.0.1:1/write?db=dsgd", timeout_s=0.2)
+        assert not bad.push_once()
+        assert m.counter("metrics.push.errors").value >= 1
+
+        # background loop ships on its own tick
+        loop = InfluxPusher(
+            m, f"http://127.0.0.1:{srv.server_address[1]}/write?db=dsgd",
+            interval_s=0.05).start()
+        before = len(received)
+        deadline = __import__("time").time() + 5
+        while __import__("time").time() < deadline and len(received) <= before:
+            __import__("time").sleep(0.02)
+        loop.stop()
+        assert len(received) > before
+    finally:
+        srv.shutdown()
+        srv.server_close()
