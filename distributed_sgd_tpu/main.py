@@ -264,6 +264,18 @@ def main() -> None:
                 "DSGD_INFLUX_URL is set: metrics are collected but not shipped")
 
     role = cfg.role
+    try:
+        _run_role(cfg, role)
+    finally:
+        # stop + final flush on EVERY exit path: a crashed run's tail
+        # metrics (incl. metrics.push.errors) are the ones that matter
+        if exporter is not None:
+            exporter.stop()
+        if pusher is not None:
+            pusher.stop()
+
+
+def _run_role(cfg: Config, role: str) -> None:
     if role == "dev":
         train, test, model = build(cfg)
         if cfg.engine == "rpc":
@@ -306,11 +318,6 @@ def main() -> None:
             seed=cfg.seed, steps_per_dispatch=cfg.steps_per_dispatch,
         ).start()
         worker.await_termination()
-
-    if exporter is not None:
-        exporter.stop()
-    if pusher is not None:
-        pusher.stop()
 
 
 if __name__ == "__main__":

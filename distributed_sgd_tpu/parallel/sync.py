@@ -557,12 +557,37 @@ class SyncEngine:
         # no index array to gather with), so auto-route it there
         kernel = "dense" if data.is_dense else self.kernel
         total, chunk = padded_layout(n_true, n_workers, self.eval_chunk)
-        padded = _pad_to_exact(data, total)
         sharding = NamedSharding(self.mesh, P(AXIS))
+        if jax.process_count() > 1 and self.mesh.size == jax.device_count():
+            # multi-host global mesh: every process passes the SAME full
+            # dataset but pads/copies ONLY its own row range
+            # (host_shard_bounds matches padded_layout's per-device
+            # ownership) before contributing it to the global array — host
+            # RAM and bind latency scale with the local shard, not the
+            # corpus.  A loader that reads only its host's slice from disk
+            # builds ShardedData directly instead (see
+            # tests/test_multihost_2proc.py's host-local path).
+            from distributed_sgd_tpu.parallel.multihost import host_shard_bounds
+
+            start, end = host_shard_bounds(n_true, eval_chunk=self.eval_chunk)
+            local = _pad_to_exact(
+                data.slice(slice(min(start, n_true), min(end, n_true))),
+                end - start,
+            )
+
+            def put(arr):
+                return jax.make_array_from_process_local_data(
+                    sharding, arr, (total,) + arr.shape[1:]
+                )
+        else:
+            local = _pad_to_exact(data, total)
+
+            def put(arr):
+                return jax.device_put(arr, sharding)
         sharded = ShardedData(
-            indices=jax.device_put(padded.indices, sharding),
-            values=jax.device_put(padded.values, sharding),
-            labels=jax.device_put(padded.labels, sharding),
+            indices=put(local.indices),
+            values=put(local.values),
+            labels=put(local.labels),
             n_true=n_true,
         )
         return BoundSync(

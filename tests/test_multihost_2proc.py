@@ -73,6 +73,36 @@ w = bound.epoch(w, key)
 loss, acc = bound.evaluate(w)
 np.save(out, np.asarray(jax.device_get(w)))
 print(f"proc {pid}: loss={loss:.6f} acc={acc:.4f}", flush=True)
+
+# -- full SyncTrainer.fit over the global mesh (multi-epoch, early stop).
+# bind() is multihost-aware: every process passes the same full dataset
+# and contributes only its own host's rows (host_shard_bounds)
+from distributed_sgd_tpu.core.early_stopping import no_improvement
+from distributed_sgd_tpu.core.trainer import SyncTrainer
+from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+tr, te = train_test_split(full)
+trainer = SyncTrainer(model, mesh, batch_size=4, learning_rate=0.3, seed=2)
+res = trainer.fit(tr, te, max_epochs=3,
+                  criterion=no_improvement(patience=2, min_delta=1e-9))
+assert res.epochs_run >= 1
+assert all(np.isfinite(x) for x in res.test_losses)
+np.save(out.replace(".npy", "_fit.npy"), np.asarray(jax.device_get(res.state.weights)))
+print(f"proc {pid}: fit epochs={res.epochs_run} "
+      f"test_loss={res.test_losses[-1]:.6f}", flush=True)
+
+# -- one local-SGD round across the 2-process global mesh: replicas
+# diverge per device, pmean averages over ICI+DCN in one compiled program
+from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
+
+lsgd = LocalSGDEngine(model, mesh, batch_size=4, learning_rate=0.1,
+                      sync_period=2, check_every=1, seed=3)
+res2 = lsgd.fit(tr, te, max_epochs=1)
+assert np.isfinite(res2.test_losses[-1])
+np.save(out.replace(".npy", "_lsgd.npy"),
+        np.asarray(jax.device_get(res2.state.weights)))
+print(f"proc {pid}: local-sgd updates={res2.state.updates} "
+      f"test_loss={res2.test_losses[-1]:.6f}", flush=True)
 """
 
 
@@ -103,3 +133,10 @@ def test_two_process_global_mesh_sync(tmp_path):
     w0, w1 = np.load(outs[0]), np.load(outs[1])
     np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
     assert np.any(w0 != 0.0)
+    # the full SyncTrainer.fit and the local-SGD round must also agree
+    # bit-for-bit across processes (pure collectives, no host divergence)
+    for suffix in ("_fit.npy", "_lsgd.npy"):
+        a = np.load(outs[0].replace(".npy", suffix))
+        b = np.load(outs[1].replace(".npy", suffix))
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+        assert np.any(a != 0.0)
