@@ -20,7 +20,7 @@ corpus; only the value *strings* repeat every ``n_template`` rows.
 from __future__ import annotations
 
 import os
-from typing import Dict, List
+from typing import Dict, List, Tuple
 
 import numpy as np
 
@@ -32,8 +32,14 @@ FIRST_DOC_ID = 2286  # real RCV1 ids start here
 
 def _template_bodies(
     n_template: int, nnz_mean: int, n_features: int, rng: np.random.Generator
-) -> List[str]:
-    """Format `n_template` random row bodies ("f:v f:v ...", 1-based ids)."""
+) -> Tuple[List[str], np.ndarray]:
+    """Format `n_template` random row bodies ("f:v f:v ...", 1-based ids).
+
+    Returns (bodies, labels): labels come from a planted linear separator
+    over the row features (like data/synthetic.rcv1_like), so a corpus
+    written from these templates is LEARNABLE — training on the parsed
+    files converges, closing the text->parse->train loop end to end.
+    """
     nnz = np.clip(rng.poisson(nnz_mean, size=n_template), 1, None)
     max_nnz = int(nnz.max())
     # Zipf-ish feature popularity like term frequencies (matches synthetic.py)
@@ -42,7 +48,9 @@ def _template_bodies(
     idx = rng.choice(n_features, size=(n_template, max_nnz), p=pop).astype(np.int32)
     idx.sort(axis=1)
     val = rng.uniform(0.001, 1.0, size=(n_template, max_nnz))
+    w_true = rng.normal(size=n_features).astype(np.float64)
     bodies: List[str] = []
+    margins = np.zeros(n_template)
     for r in range(n_template):
         row_idx = idx[r, : nnz[r]]
         # file rows cannot repeat a feature id (they decode into a map in
@@ -51,10 +59,12 @@ def _template_bodies(
         keep[1:] = row_idx[1:] != row_idx[:-1]
         row_idx = row_idx[keep]
         row_val = val[r, : nnz[r]][keep]
+        margins[r] = float(np.dot(row_val, w_true[row_idx]))
         bodies.append(
             " ".join(f"{c + 1}:{v:.6f}" for c, v in zip(row_idx, row_val))
         )
-    return bodies
+    labels = np.where(margins > np.median(margins), 1, -1).astype(np.int32)
+    return bodies, labels
 
 
 def write_rcv1_corpus(
@@ -67,13 +77,18 @@ def write_rcv1_corpus(
     # 115 draws land at ~76 distinct, reported as `nnz_per_row` in metadata
     nnz_mean: int = 115,
     n_features: int = 47236,
-    ccat_frac: float = 0.47,
+    label_noise: float = 0.05,
     seed: int = 0,
     chunk: int = 65536,
 ) -> Dict[str, object]:
-    """Write train + 4 test parts + qrels into `folder`; returns metadata."""
+    """Write train + 4 test parts + qrels into `folder`; returns metadata.
+
+    Labels follow the templates' planted separator (CCAT = +1 side) with
+    `label_noise` random flips, so the corpus is learnable after parsing.
+    """
     rng = np.random.default_rng(seed)
-    bodies = _template_bodies(min(n_template, n_rows), nnz_mean, n_features, rng)
+    bodies, tmpl_labels = _template_bodies(
+        min(n_template, n_rows), nnz_mean, n_features, rng)
     n_template = len(bodies)
     tokens_per_row = sum(b.count(":") for b in bodies) / n_template
 
@@ -101,8 +116,12 @@ def write_rcv1_corpus(
         total_bytes += os.path.getsize(path)
 
     # qrels: one line per doc (+ an extra preceding topic line for every
-    # 50th doc so the last-line-wins overwrite path runs at scale too)
-    is_ccat = rng.random(n_rows) < ccat_frac
+    # 50th doc so the last-line-wins overwrite path runs at scale too).
+    # doc i reuses template (FIRST_DOC_ID + i) % n_template — same mapping
+    # as the row bodies above — so its label matches its features
+    doc_labels = tmpl_labels[(FIRST_DOC_ID + np.arange(n_rows)) % n_template]
+    flip = rng.random(n_rows) < label_noise
+    is_ccat = np.where(flip, -doc_labels, doc_labels) == 1
     other = rng.choice(["ECAT", "GCAT", "MCAT"], size=n_rows)
     qrels = os.path.join(folder, "rcv1-v2.topics.qrels")
     with open(qrels, "w") as f:
