@@ -1,0 +1,48 @@
+"""Deploy-config consistency: every DSGD_* key the k8s manifests inject
+must be a key the process actually reads (Config.from_env, or the two
+documented out-of-Config knobs).  Guards the env contract the reference
+also relies on (kube ConfigMaps -> application.conf ${?DSGD_*} overrides,
+kube/config-sync.yaml:7-21)."""
+
+import os
+import re
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# read outside Config by design (main.load_data / kube podIP injection)
+SPECIAL = {"DSGD_SYNTHETIC"}
+
+
+def _known_env_keys():
+    src = open(os.path.join(REPO, "distributed_sgd_tpu", "config.py")).read()
+    return set(re.findall(r'_env\("(DSGD_[A-Z_]+)"', src)) | SPECIAL
+
+
+def _manifest_keys():
+    keys = set()
+    for name in ("config-sync.yaml", "config-async.yaml", "dsgd.yaml", "monitor.yaml"):
+        path = os.path.join(REPO, "kube", name)
+        for doc in yaml.safe_load_all(open(path)):
+            if not doc:
+                continue
+            text = yaml.dump(doc)
+            keys |= set(re.findall(r"(DSGD_[A-Z_]+)", text))
+    return keys
+
+
+def test_every_manifest_key_is_read_by_config():
+    known = _known_env_keys()
+    unknown = _manifest_keys() - known
+    assert not unknown, (
+        f"kube manifests set env keys the process never reads: {sorted(unknown)}"
+    )
+
+
+def test_role_selection_keys_present_in_cluster_manifest():
+    """dsgd.yaml must inject the role-selection keys (Main.scala:122-159
+    contract): workers need master host/port + their own podIP host."""
+    text = open(os.path.join(REPO, "kube", "dsgd.yaml")).read()
+    for key in ("DSGD_MASTER_HOST", "DSGD_MASTER_PORT", "DSGD_NODE_HOST"):
+        assert key in text, key
