@@ -45,6 +45,14 @@ class LossChecker:
         self.smoothed_accs: List[float] = []  # newest first
         self.best_loss = float("inf")
         self.best_weights: Optional[np.ndarray] = None
+        # lifetime update count at the last snapshot: async drivers seed
+        # their update counter from this so a resumed fit spends only the
+        # REMAINING budget (maxSteps counts lifetime updates,
+        # MasterAsync.scala:83), not a fresh full one.  _updates_seen is
+        # the monotone high-water mark persisted on save: a check() without
+        # an explicit step must never regress the snapshot's count
+        self.restored_updates = 0
+        self._updates_seen = 0
         if checkpointer is not None:
             restored = checkpointer.restore_latest()
             if restored is not None:
@@ -69,12 +77,17 @@ class LossChecker:
                     self.smoothed_accs = [
                         float(x) for x in np.asarray(state["smoothed_accs_nf"])
                     ]
+                if "updates" in state:
+                    self.restored_updates = int(state["updates"])
+                    self._updates_seen = self.restored_updates
 
     def check(self, raw_loss: float, raw_acc: float, weights, step: Optional[int] = None) -> bool:
         """Record one evaluation; returns True if training should stop.
 
         `step` (e.g. the update count) labels the persisted checkpoint; it
         defaults to the number of checks so far."""
+        if step is not None:
+            self._updates_seen = max(self._updates_seen, int(step))
         prev = self.smoothed[0] if self.smoothed else raw_loss
         loss = self.leaky * raw_loss + (1 - self.leaky) * prev
         prev_acc = self.smoothed_accs[0] if self.smoothed_accs else raw_acc
@@ -107,6 +120,11 @@ class LossChecker:
                     "best_loss": self.best_loss,
                     "smoothed_nf": np.asarray(self.smoothed, np.float32),
                     "smoothed_accs_nf": np.asarray(self.smoothed_accs, np.float32),
+                    # lifetime update count (callers pass their — already
+                    # resume-seeded — update counter as `step`); the
+                    # monotone high-water mark, so a step-less check can
+                    # never regress a restored count back toward zero
+                    "updates": self._updates_seen,
                 },
             )
             self._checks_since_save = 0
@@ -120,3 +138,28 @@ class LossChecker:
     @property
     def acc_history(self) -> List[float]:
         return list(reversed(self.smoothed_accs))
+
+
+def async_fit_result(checker: "LossChecker", w0, t_start: float,
+                     updates: int, batch_size: int, n_samples: int):
+    """Assemble an async fit's FitResult from the checker's state: the
+    BEST weights, not the last (MasterAsync.scala:87-94), with inf -> nan
+    loss normalization and the epochs_run back-computation.  Shared by
+    every async driver's normal exit and resumed-past-budget
+    short-circuit (gRPC fit_async, HogwildEngine, LocalSGDEngine)."""
+    import jax.numpy as jnp
+
+    from distributed_sgd_tpu.core.grad_state import GradState
+    from distributed_sgd_tpu.core.trainer import FitResult
+
+    best = checker.best_weights if checker.best_weights is not None else w0
+    result = FitResult(state=GradState(
+        weights=jnp.asarray(best),
+        loss=checker.best_loss if checker.best_loss != float("inf") else float("nan"),
+        start=t_start,
+        updates=updates,
+    ).finish())
+    result.test_losses = checker.history
+    result.test_accuracies = checker.acc_history
+    result.epochs_run = updates * batch_size // max(n_samples, 1)
+    return result

@@ -30,7 +30,7 @@ import numpy as np
 
 from distributed_sgd_tpu.core.early_stopping import Criterion
 from distributed_sgd_tpu.core.grad_state import GradState
-from distributed_sgd_tpu.core.loss_check import LossChecker
+from distributed_sgd_tpu.core.loss_check import LossChecker, async_fit_result
 from distributed_sgd_tpu.core.split import vanilla_split
 from distributed_sgd_tpu.core.trainer import FitResult
 from distributed_sgd_tpu.data.rcv1 import Dataset
@@ -614,12 +614,24 @@ class MasterNode:
             if initial_weights is None
             else np.asarray(initial_weights, dtype=np.float32)
         )
+        # the checker restores any prior snapshot, including the lifetime
+        # update count: maxSteps is a LIFETIME budget (MasterAsync.scala:83
+        # counts updates across the whole computation), so a resumed fit
+        # starts its counter at the restored count and spends only the
+        # remainder
+        checker = LossChecker(leaky_loss, criterion, checkpointer=checkpointer)
+        t_start = time.time()
         with self._async_lock:
             self._w_async = jnp.asarray(w0)
-            self._updates = 0
+            self._updates = checker.restored_updates
             self._max_steps = len(self.train) * max_epochs  # MasterAsync.scala:83
+        if self._updates >= self._max_steps:
+            self.log.info(
+                "resumed past the %d-step budget (%d updates done): nothing to run",
+                self._max_steps, self._updates)
+            return async_fit_result(
+                checker, w0, t_start, self._updates, batch_size, len(self.train))
         self._async_running.set()
-        t_start = time.time()
 
         wmsg = codec.encode_tensor(w0)
         for stub, part in zip(stubs, parts):  # MasterAsync.scala:52-55
@@ -636,9 +648,7 @@ class MasterNode:
             )
         self.log.info("waiting for slaves updates")
 
-        checker = LossChecker(leaky_loss, criterion, checkpointer=checkpointer)
-        result = FitResult(state=GradState(weights=w0))
-        last_step = -check_every
+        last_step = self._updates - check_every  # first check runs immediately
         while self._async_running.is_set():
             with self._async_lock:
                 updates = self._updates
@@ -662,17 +672,9 @@ class MasterNode:
                 break
 
         self._end_async(stubs)
-        result.test_losses = checker.history
-        result.test_accuracies = checker.acc_history
-        best_w = checker.best_weights if checker.best_weights is not None else w0
-        result.state = GradState(  # BEST weights (MasterAsync.scala:87-94)
-            weights=jnp.asarray(best_w),
-            loss=checker.best_loss if checker.best_loss != float("inf") else float("nan"),
-            start=t_start,
-            updates=self._updates,
-        ).finish()
-        result.epochs_run = self._updates * batch_size // max(len(self.train), 1)
-        return result
+        # BEST weights, not last (MasterAsync.scala:87-94)
+        return async_fit_result(
+            checker, w0, t_start, self._updates, batch_size, len(self.train))
 
     def _end_async(self, stubs) -> None:
         self._async_running.clear()

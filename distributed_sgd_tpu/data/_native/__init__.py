@@ -33,6 +33,7 @@ class _CsrResult(ctypes.Structure):
         ("row_ptr", ctypes.POINTER(ctypes.c_int64)),
         ("col_idx", ctypes.POINTER(ctypes.c_int32)),
         ("values", ctypes.POINTER(ctypes.c_float)),
+        ("skipped_lines", ctypes.c_int64),
     ]
 
 
@@ -91,6 +92,13 @@ def parse_svm_file(
     try:
         r = res.contents
         n, nnz = r.n_rows, r.nnz
+        if r.skipped_lines:
+            # The python fallback (and the reference, Dataset.scala:24) raise
+            # on a non-numeric doc id; the native scanner drops such lines.
+            # Surface the count so the divergence is observable.
+            log.warning(
+                "native parser skipped %d malformed line(s) in %s (python "
+                "fallback would raise on these)", r.skipped_lines, path)
         doc_ids = np.ctypeslib.as_array(r.doc_ids, shape=(n,)).copy()
         row_ptr = np.ctypeslib.as_array(r.row_ptr, shape=(n + 1,)).copy()
         col_idx = np.ctypeslib.as_array(r.col_idx, shape=(max(nnz, 1),))[:nnz].copy()

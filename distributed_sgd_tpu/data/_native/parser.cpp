@@ -27,6 +27,7 @@ struct ChunkOut {
   std::vector<int64_t> row_nnz;
   std::vector<int32_t> col_idx;
   std::vector<float> values;
+  int64_t skipped_lines = 0;
 };
 
 // Parse [begin, end) which is aligned to line boundaries.
@@ -48,7 +49,10 @@ void parse_chunk(const char* begin, const char* end, int32_t index_offset,
     if (*p == '+') ++p;
     long doc = 0;
     auto rd = std::from_chars(p, end, doc);
-    if (rd.ptr == p) {  // not a number: skip the malformed line entirely
+    if (rd.ptr == p) {  // not a number: skip the malformed line entirely.
+      // Counted so callers can observe the divergence from the python
+      // fallback / reference (Dataset.scala:24), which raise here instead.
+      ++out->skipped_lines;
       while (p < end && *p != '\n') ++p;
       continue;
     }
@@ -98,6 +102,7 @@ struct CsrResult {
   int64_t* row_ptr;  // [n_rows + 1]
   int32_t* col_idx;  // [nnz]
   float* values;     // [nnz]
+  int64_t skipped_lines;  // malformed (non-numeric doc id) lines dropped
 };
 
 // Parse a whole file. index_offset is added to every feature id (use -1 to
@@ -144,13 +149,15 @@ CsrResult* dsgd_parse_svm(const char* path, int n_threads,
   for (auto& th : threads) th.join();
 
   auto* res = static_cast<CsrResult*>(malloc(sizeof(CsrResult)));
-  int64_t n_rows = 0, nnz = 0;
+  int64_t n_rows = 0, nnz = 0, skipped = 0;
   for (auto& o : outs) {
     n_rows += static_cast<int64_t>(o.doc_ids.size());
     nnz += static_cast<int64_t>(o.values.size());
+    skipped += o.skipped_lines;
   }
   res->n_rows = n_rows;
   res->nnz = nnz;
+  res->skipped_lines = skipped;
   res->doc_ids = static_cast<int32_t*>(malloc(sizeof(int32_t) * n_rows));
   res->row_ptr = static_cast<int64_t*>(malloc(sizeof(int64_t) * (n_rows + 1)));
   res->col_idx = static_cast<int32_t*>(malloc(sizeof(int32_t) * (nnz ? nnz : 1)));

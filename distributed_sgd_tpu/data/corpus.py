@@ -46,13 +46,16 @@ def _template_bodies(
     pop = 1.0 / np.arange(1, n_features + 1, dtype=np.float64)
     pop /= pop.sum()
     idx = rng.choice(n_features, size=(n_template, max_nnz), p=pop).astype(np.int32)
-    idx.sort(axis=1)
     val = rng.uniform(0.001, 1.0, size=(n_template, max_nnz))
     w_true = rng.normal(size=n_features).astype(np.float64)
     bodies: List[str] = []
     margins = np.zeros(n_template)
     for r in range(n_template):
-        row_idx = idx[r, : nnz[r]]
+        # slice to this row's draws FIRST, then sort: sorting the full
+        # max_nnz row and truncating would leave short rows holding the
+        # sorted prefix (systematically low feature ids), skewing the
+        # corpus's popularity profile beyond the intended Zipf draw
+        row_idx = np.sort(idx[r, : nnz[r]])
         # file rows cannot repeat a feature id (they decode into a map in
         # the reference, Dataset.scala:24-33): drop duplicate draws
         keep = np.ones(len(row_idx), dtype=bool)

@@ -49,8 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_sgd_tpu.core.early_stopping import Criterion
-from distributed_sgd_tpu.core.grad_state import GradState
-from distributed_sgd_tpu.core.loss_check import LossChecker
+from distributed_sgd_tpu.core.loss_check import LossChecker, async_fit_result
 from distributed_sgd_tpu.core.split import vanilla_split
 from distributed_sgd_tpu.core.trainer import FitResult
 from distributed_sgd_tpu.data.rcv1 import Dataset
@@ -328,10 +327,21 @@ class HogwildEngine:
             if initial_weights is None
             else np.asarray(initial_weights, dtype=np.float32)
         )
+        # the checker restores any prior snapshot, including the lifetime
+        # update count: maxSteps is a LIFETIME budget (MasterAsync.scala:83),
+        # so a resumed fit seeds its counter and spends only the remainder
+        checker = LossChecker(self.leaky_loss, criterion, checkpointer=self.checkpointer)
+        t_start = time.time()
         self._w_master = jnp.asarray(w0)
-        self._updates = 0
+        self._updates = checker.restored_updates
         self._max_steps = n * max_epochs  # MasterAsync.scala:83
         self._stop.clear()
+        if self._updates >= self._max_steps:
+            log.info(
+                "resumed past the %d-step budget (%d updates done): nothing to run",
+                self._max_steps, self._updates)
+            return async_fit_result(
+                checker, w0, t_start, self._updates, self.batch_size, n)
 
         # contiguous shard assignment, as the reference's vanilla split
         splits = vanilla_split(n, self.n_workers)
@@ -357,14 +367,10 @@ class HogwildEngine:
         # master-local test eval (the loss checker's localLoss equivalent)
         eval_bound = SyncEngine(self.model, make_mesh(1), self.batch_size, 0.0).bind(test)
 
-        result = FitResult(state=GradState(weights=self._w_master))
-        checker = LossChecker(self.leaky_loss, criterion, checkpointer=self.checkpointer)
-        t_start = time.time()
-
         for w in workers:
             w.start_async(w0)
 
-        last_step = -self.check_every  # first check runs immediately
+        last_step = self._updates - self.check_every  # first check runs immediately
         try:
             while not self._stop.is_set():
                 with self._lock:
@@ -395,14 +401,5 @@ class HogwildEngine:
                 w.join()
 
         # return BEST weights (MasterAsync.scala:87-94)
-        result.test_losses = checker.history
-        result.test_accuracies = checker.acc_history
-        best_w = checker.best_weights if checker.best_weights is not None else w0
-        result.state = GradState(
-            weights=jnp.asarray(best_w),
-            loss=checker.best_loss if checker.best_loss != float("inf") else float("nan"),
-            start=t_start,
-            updates=self._updates,
-        ).finish()
-        result.epochs_run = self._updates * self.batch_size // max(n, 1)
-        return result
+        return async_fit_result(
+            checker, w0, t_start, self._updates, self.batch_size, n)

@@ -29,8 +29,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from distributed_sgd_tpu.core.early_stopping import Criterion
-from distributed_sgd_tpu.core.grad_state import GradState
-from distributed_sgd_tpu.core.loss_check import LossChecker
+from distributed_sgd_tpu.core.loss_check import LossChecker, async_fit_result
 from distributed_sgd_tpu.core.trainer import FitResult
 from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
@@ -170,9 +169,12 @@ class LocalSGDEngine:
             if opt is not None else None
         )
         key = jax.random.PRNGKey(self.seed)
-        result = FitResult(state=GradState(weights=w))
         checker = LossChecker(self.leaky_loss, criterion, checkpointer=self.checkpointer)
-        steps_done, last_check = 0, -self.check_every
+        # maxSteps is a LIFETIME budget (MasterAsync.scala:83): a resumed
+        # fit seeds the step counter from the snapshot and runs only the
+        # remainder
+        steps_done = checker.restored_updates
+        last_check = steps_done - self.check_every
         t_start = time.time()
 
         while steps_done < max_steps:
@@ -198,14 +200,5 @@ class LocalSGDEngine:
                 log.info("converged to target: stopping computation")
                 break
 
-        result.test_losses = checker.history
-        result.test_accuracies = checker.acc_history
-        best_w = checker.best_weights if checker.best_weights is not None else np.asarray(w)
-        result.state = GradState(
-            weights=jnp.asarray(best_w),
-            loss=checker.best_loss if checker.best_loss != float("inf") else float("nan"),
-            start=t_start,
-            updates=steps_done,
-        ).finish()
-        result.epochs_run = steps_done * self.batch_size // max(n, 1)
-        return result
+        return async_fit_result(
+            checker, np.asarray(w), t_start, steps_done, self.batch_size, n)

@@ -38,8 +38,12 @@ tests/test_dense_path.py).
 Batch sampling mirrors Master.scala:184 (`split.map(Random.shuffle(_))`
 then slice): every step each worker draws a fresh uniform batch from its
 shard.  `sampling='fresh'` reproduces this with per-step uniform draws
-(with replacement — delta documented); `sampling='epoch'` walks a per-epoch
-permutation (classic epoch semantics, stronger convergence).
+(with replacement — delta documented); `sampling='epoch'` has each
+(virtual) worker walk a per-epoch permutation of its OWN disjoint
+ceil-split sub-shard (classic epoch semantics, stronger convergence).
+Both modes use the same vanilla-split sample ownership
+(SplitStrategy.scala:13-14): switching sampling never changes which
+samples a worker may touch.
 
 Evaluation (objective + accuracy over a full split) also runs sharded and
 chunked on device, replacing the reference's master-local full-dataset
@@ -196,31 +200,45 @@ class BoundSync:
 
     # -- per-device bodies (run under shard_map) ---------------------------
 
+    def _subshards(self):
+        """(sub, starts, sizes): the per-virtual-worker ceil-split of this
+        device's shard — the vanilla-split assignment
+        (SplitStrategy.scala:13-14: grouped(ceil(n/k))).  The SINGLE source
+        of sample ownership: both sampling modes and the trainability check
+        derive from it, so ownership can never diverge between modes."""
+        k = self.virtual_workers
+        sub = -(-self.shard_n // k)  # ceil
+        starts = np.minimum(np.arange(k) * sub, self.shard_n - 1)
+        sizes = np.maximum(self.shard_n - starts, 1)
+        return sub, starts, sizes
+
     def _sample_ids(self, key: jax.Array, step: jax.Array) -> jax.Array:
-        """[virtual_workers, batch_size] sample ids into this device's shard."""
+        """[virtual_workers, batch_size] sample ids into this device's shard.
+
+        Each virtual worker draws ONLY from its own disjoint contiguous
+        ceil-split sub-shard (_subshards), so the K-virtual and K-device
+        topologies partition data identically and every sample is
+        reachable.  The short trailing sub-shard maps out-of-range draws in
+        via modulo (bias/duplicates bounded by sub - size).
+        """
         k, b = self.virtual_workers, self.batch_size
+        sub, starts, sizes = self._subshards()
+        wrap = jnp.asarray(np.minimum(sub, sizes))
         if self.sampling == "fresh":
             # fresh uniform draw per step, like the per-batch reshuffle in
-            # Master.scala:184 (delta: with replacement within a batch).
-            # Each virtual worker draws from its own DISJOINT contiguous
-            # sub-shard of CEIL size — exactly the vanilla-split assignment
-            # (SplitStrategy.scala:13-14: grouped(ceil(n/k))), so the
-            # K-virtual and K-device topologies partition data identically
-            # and every sample is reachable.  The short final sub-shard
-            # maps draws in via modulo (bias bounded by 1/size; sampling
-            # here is already with-replacement)
-            sub = -(-self.shard_n // k)  # ceil
-            starts = np.minimum(np.arange(k) * sub, self.shard_n - 1)
-            sizes = np.maximum(self.shard_n - starts, 1)
-            base = jax.random.randint(
-                jax.random.fold_in(key, step), (k, b), 0, sub
-            )
-            base = base % jnp.asarray(np.minimum(sub, sizes))[:, None]
-            return base + jnp.asarray(starts, dtype=base.dtype)[:, None]
-        # 'epoch': walk a per-epoch permutation in contiguous slices
-        perm = jax.random.permutation(key, self.shard_n)
-        start = jnp.minimum(step * k * b, self.shard_n - k * b)
-        return jax.lax.dynamic_slice(perm, (start,), (k * b,)).reshape(k, b)
+            # Master.scala:184 (delta: with replacement within a batch)
+            sel = jax.random.randint(jax.random.fold_in(key, step), (k, b), 0, sub)
+        else:
+            # 'epoch': each virtual worker walks a per-epoch permutation of
+            # its own sub-shard (VERDICT r3 item 5: same ownership as
+            # 'fresh', sampling without replacement within the epoch)
+            perms = jax.vmap(jax.random.permutation, in_axes=(0, None))(
+                jax.random.split(key, k), sub
+            )  # [k, sub]
+            start = jnp.minimum(step * b, sub - b)
+            sel = jax.lax.dynamic_slice(perms, (jnp.zeros_like(start), start), (k, b))
+        sel = sel % wrap.astype(sel.dtype)[:, None]
+        return sel + jnp.asarray(starts, dtype=sel.dtype)[:, None]
 
     def _worker_grad(self, w, batch, by):
         """One reference worker's Gradient reply: per-sample backward SUM +
@@ -380,15 +398,16 @@ class BoundSync:
     def _check_trainable(self) -> None:
         """Checked at train-call time, not bind time: an eval-only binding
         (e.g. the test split) never samples batches."""
-        if self.sampling == "epoch" and self.virtual_workers * self.batch_size > self.shard_n:
-            raise ValueError(
-                f"sampling='epoch' needs virtual_workers*batch_size "
-                f"({self.virtual_workers}*{self.batch_size}) <= per-device shard "
-                f"({self.shard_n}); lower the batch size or worker count"
-            )
         k = self.virtual_workers
-        sub = -(-self.shard_n // k)
-        if self.sampling == "fresh" and k > 1 and (k - 1) * sub >= self.shard_n:
+        sub, _starts, _sizes = self._subshards()
+        if self.sampling == "epoch" and self.batch_size > sub:
+            raise ValueError(
+                f"sampling='epoch' needs batch_size ({self.batch_size}) <= "
+                f"per-virtual-worker sub-shard ({sub} = "
+                f"ceil({self.shard_n}/{k})); lower the batch size or worker "
+                f"count"
+            )
+        if k > 1 and (k - 1) * sub >= self.shard_n:
             # vanilla_split would hand the trailing worker(s) an EMPTY
             # group here (grouped(ceil) yields < k groups); rather than
             # silently double-weighting the last sample, refuse

@@ -86,9 +86,11 @@ class GossipSender:
     unbounded in-flight RPCs against a slow or wedged peer.  This keeps at
     most `max_inflight` outstanding UpdateGrad calls per peer: completed
     futures are pruned on every send, and when the window is still full the
-    OLDEST in-flight call is cancelled and counted under
-    `slave.async.grad.dropped` — the same drop-oldest-under-overload policy
-    as the in-process engine's bounded inbox (parallel/hogwild.py).
+    OLDEST in-flight call is cancelled — and counted under
+    `slave.async.grad.dropped` once it settles as actually-cancelled (a
+    call already executing server-side may still be delivered despite the
+    cancel) — the same drop-oldest-under-overload policy as the in-process
+    engine's bounded inbox (parallel/hogwild.py).
     """
 
     def __init__(self, call, metrics=None, max_inflight: int = 64):
@@ -114,7 +116,15 @@ class GossipSender:
                 old = self._inflight.pop(0)
                 old.cancel()  # best-effort; the delta is lost, as the wire allows
                 if self._metrics is not None:
-                    self._metrics.counter("slave.async.grad.dropped").increment()
+                    # grpc cancel is best-effort: a call already executing
+                    # server-side is still delivered, so count the drop only
+                    # once the future settles as actually-cancelled —
+                    # otherwise slave.async.grad.dropped overstates delta loss
+                    metrics = self._metrics
+                    old.add_done_callback(
+                        lambda f: f.cancelled()
+                        and metrics.counter("slave.async.grad.dropped").increment()
+                    )
             try:
                 self._inflight.append(self._call.future(msg))
             except ValueError:  # channel closed under us

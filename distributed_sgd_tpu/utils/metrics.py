@@ -258,6 +258,15 @@ class InfluxPusher:
             return False
         if ok:
             self._failing = False
+        else:
+            # Non-2xx that urllib did not raise on (e.g. a 3xx from a proxy)
+            # is still a dropped push — same accounting as the except path.
+            self.metrics.counter("metrics.push.errors").increment()
+            if not self._failing:
+                logging.getLogger("dsgd.metrics").warning(
+                    "influx push to %s returned non-2xx status %s; will keep "
+                    "retrying silently", self.url, resp.status)
+                self._failing = True
         return ok
 
     def _loop(self) -> None:
