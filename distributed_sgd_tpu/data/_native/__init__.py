@@ -23,6 +23,9 @@ _SRC = os.path.join(_DIR, "parser.cpp")
 _LIB = os.path.join(_DIR, "_libdsgd_parser.so")
 _LOCK = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
+# must match parser.cpp dsgd_abi_version(): the CsrResult struct layout
+# (and any function signature) is pinned by this number
+_ABI_VERSION = 2
 
 
 class _CsrResult(ctypes.Structure):
@@ -35,6 +38,17 @@ class _CsrResult(ctypes.Structure):
         ("values", ctypes.POINTER(ctypes.c_float)),
         ("skipped_lines", ctypes.c_int64),
     ]
+
+
+def _abi_version(lib: ctypes.CDLL) -> int:
+    """Library's reported ABI version; 0 if it predates the export."""
+    try:
+        fn = lib.dsgd_abi_version
+    except AttributeError:
+        return 0
+    fn.restype = ctypes.c_int32
+    fn.argtypes = []
+    return int(fn())
 
 
 def _build() -> None:
@@ -56,6 +70,17 @@ def load() -> Optional[ctypes.CDLL]:
             if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
                 _build()
             lib = ctypes.CDLL(_LIB)
+            if _abi_version(lib) != _ABI_VERSION:
+                # stale prebuilt .so whose mtime survived COPY/rsync/tar:
+                # an mtime check cannot see it, but reading the grown
+                # CsrResult through the old layout would be out-of-bounds
+                log.info("native parser ABI mismatch; rebuilding")
+                _build()
+                lib = ctypes.CDLL(_LIB)
+                if _abi_version(lib) != _ABI_VERSION:
+                    raise RuntimeError(
+                        f"rebuilt native parser still reports ABI "
+                        f"{_abi_version(lib)}, expected {_ABI_VERSION}")
             lib.dsgd_parse_svm.restype = ctypes.POINTER(_CsrResult)
             lib.dsgd_parse_svm.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int32]
             lib.dsgd_free_csr.argtypes = [ctypes.POINTER(_CsrResult)]

@@ -95,6 +95,12 @@ void parse_chunk(const char* begin, const char* end, int32_t index_offset,
 
 extern "C" {
 
+// Bumped on every CsrResult/function-signature change.  The ctypes loader
+// refuses (and rebuilds) a library reporting a different version — an
+// mtime staleness check alone cannot catch a stale prebuilt .so whose
+// timestamp was normalized by COPY/rsync/tar.
+int32_t dsgd_abi_version() { return 2; }
+
 struct CsrResult {
   int64_t n_rows;
   int64_t nnz;

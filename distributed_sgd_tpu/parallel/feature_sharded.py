@@ -17,7 +17,20 @@ parallel/sync.py over a 2-D mesh ('workers', 'features'):
              broadcast);
 - scatter:   each shard scatters only into its own weight rows — no
              collective needed; the gradient inherits the weight sharding;
+- regularize: 'l2' is purely shard-local (2*lam*w rows); 'dim_sparsity'
+             (the reference-exact SparseSVM.scala:31 scalar) needs the
+             GLOBAL dot w . dimSparsity — one extra scalar `psum` of the
+             shard-local partial dots over 'features', then the same
+             g != 0 mask as models/linear.py regularize_blocked;
 - reduce:    `psum` over 'workers' (the DP mean), exactly sync.py's.
+
+Dense-layout datasets (Dataset.dense, no index array) run the same 2-D
+semantics with the gather/scatter collapsed to plain matmuls: rows are
+additionally COLUMN-sharded over 'features' (each device holds the
+[N/W, D/F] tile matching its weight rows), partial margins are a local
+[B, D/F] @ [D/F] matvec psum'd over 'features', and the gradient
+outer-product coeff @ x_local lands directly in the local weight tile.
+Column padding to the blocked row grid costs at most 8*F*128 features.
 
 Weight memory and the scatter/gather matmul FLOPs both scale 1/F per
 device — the pattern that matters when the feature dimension outgrows one
@@ -64,12 +77,6 @@ class FeatureShardedEngine:
         batch_size: int,
         learning_rate: float,
     ):
-        if model.regularizer == "dim_sparsity":
-            # the dim_sparsity scalar needs a global w . ds dot; supported
-            # via an extra psum — kept out of this demo engine for clarity
-            raise NotImplementedError(
-                "feature-sharded engine supports regularizer='l2' or 'none'"
-            )
         self.model = model
         self.mesh = mesh
         self.batch_size = int(batch_size)
@@ -83,7 +90,26 @@ class FeatureShardedEngine:
 
     # -- shard bodies ------------------------------------------------------
 
-    def _step(self, w2_local, idx, val, y, key, step):
+    def _regularize_reduce(self, g_local, w2_local, ds_local):
+        """Shared tail of both layouts: per-worker regularize (the worker
+        reply semantics, Slave.scala:153-155) then the DP mean psum
+        (Master.scala:194) and the SGD update."""
+        reg = self.model.regularizer
+        if reg == "dim_sparsity":
+            # reference-exact scalar lam*2*(w . dimSparsity)
+            # (SparseSVM.scala:31): the dot spans ALL features, so psum the
+            # shard-local partials; the g != 0 support mask stays local —
+            # identical semantics to regularize_blocked on unsharded weights
+            scalar = self.model.lam * 2.0 * jax.lax.psum(
+                jnp.sum(w2_local.astype(jnp.float32) * ds_local), FEATURES
+            )
+            g_local = g_local + jnp.where(g_local != 0, scalar, 0.0)
+        elif reg == "l2":
+            g_local = g_local + 2.0 * self.model.lam * w2_local
+        g_local = jax.lax.psum(g_local, WORKERS) / self.n_workers  # DP mean
+        return w2_local - self.learning_rate * g_local
+
+    def _step(self, w2_local, idx, val, y, key, step, ds_local):
         ids = jax.random.randint(
             jax.random.fold_in(key, step), (self.batch_size,), 0, self.shard_n
         )
@@ -98,45 +124,97 @@ class FeatureShardedEngine:
         m = jax.lax.psum(oh.margins(w2_local), FEATURES)  # TP partial-sum
         coeff = self.model.grad_coeff(m, by)  # redundant per feature shard
         g_local = oh.scatter_add(coeff)  # stays feature-sharded
-        if self.model.regularizer == "l2":
-            g_local = g_local + 2.0 * self.model.lam * w2_local
-        g_local = jax.lax.psum(g_local, WORKERS) / self.n_workers  # DP mean
-        return w2_local - self.learning_rate * g_local
+        return self._regularize_reduce(g_local, w2_local, ds_local)
+
+    def _step_dense(self, w2_local, val, y, key, step, ds_local):
+        ids = jax.random.randint(
+            jax.random.fold_in(key, step), (self.batch_size,), 0, self.shard_n
+        )
+        bv, by = val[ids], y[ids]  # [B, r_local*LANES] column tile
+        w_flat = w2_local.reshape(-1).astype(jnp.float32)
+        m = jax.lax.psum(  # TP partial margins over the column tiles
+            jnp.dot(bv.astype(jnp.float32), w_flat,
+                    precision=jax.lax.Precision.HIGHEST),
+            FEATURES,
+        )
+        coeff = self.model.grad_coeff(m, by)
+        g_local = jnp.dot(  # outer-product lands in the local tile
+            coeff.astype(jnp.float32), bv.astype(jnp.float32),
+            precision=jax.lax.Precision.HIGHEST,
+        ).reshape(self.r_local, LANES)
+        return self._regularize_reduce(g_local, w2_local, ds_local)
 
     # -- host API ----------------------------------------------------------
 
-    def bind(self, data: Dataset):
-        if data.is_dense:
-            raise NotImplementedError(
-                "feature-sharded engine needs indexed (sparse-layout) rows; "
-                "dense-layout data runs on SyncEngine's dense kernel instead"
+    def _bind_ds(self):
+        """Blocked dimSparsity operand, padded to the r_total row grid and
+        sharded over 'features' like the weights (zeros when unused — the
+        regularizer branch in _regularize_reduce is static, so the array is
+        dead in the compiled program for 'l2'/'none')."""
+        ds_full = np.zeros((self.r_total, LANES), np.float32)
+        if self.model.regularizer == "dim_sparsity":
+            ds_np = mxu.to_blocked_np(
+                np.asarray(self.model.dim_sparsity), self.model.n_features
             )
+            ds_full[: ds_np.shape[0]] = ds_np
+        return jax.device_put(
+            jnp.asarray(ds_full), NamedSharding(self.mesh, P(FEATURES, None))
+        )
+
+    def bind(self, data: Dataset):
+        self.dense = data.is_dense
         total, _chunk = padded_layout(len(data), self.n_workers, 4096)
         padded = _pad_to_exact(data, total)
         self.shard_n = total // self.n_workers
-        d_sh = NamedSharding(self.mesh, P(WORKERS, None))
-        self._idx = jax.device_put(padded.indices, d_sh)
-        self._val = jax.device_put(padded.values, d_sh)
+        self._ds = self._bind_ds()
+        if self.dense:
+            # column-pad the dense rows to the blocked row grid so the
+            # feature axis splits into exactly n_shards weight-row tiles
+            cols = self.r_total * LANES
+            v = np.zeros((total, cols), np.float32)
+            v[:, : padded.values.shape[1]] = padded.values
+            self._idx = None
+            self._val = jax.device_put(
+                v, NamedSharding(self.mesh, P(WORKERS, FEATURES))
+            )
+        else:
+            d_sh = NamedSharding(self.mesh, P(WORKERS, None))
+            self._idx = jax.device_put(padded.indices, d_sh)
+            self._val = jax.device_put(padded.values, d_sh)
         self._y = jax.device_put(padded.labels, NamedSharding(self.mesh, P(WORKERS)))
         max_shard = math.ceil(len(data) / self.n_workers)
         self.steps_per_epoch = max(1, math.ceil(max_shard / self.batch_size))
 
-        def epoch_shard(w2, idx, val, y, key):
-            key = jax.random.fold_in(key, jax.lax.axis_index(WORKERS))
+        wspec = P(FEATURES, None)
+        if self.dense:
 
-            def body(c, s):
-                return self._step(c, idx, val, y, key, s), ()
+            def epoch_shard(w2, val, y, key, ds):
+                key = jax.random.fold_in(key, jax.lax.axis_index(WORKERS))
 
-            w2, _ = jax.lax.scan(body, w2, jnp.arange(self.steps_per_epoch))
-            return w2
+                def body(c, s):
+                    return self._step_dense(c, val, y, key, s, ds), ()
 
-        dspec = (P(WORKERS), P(WORKERS), P(WORKERS))
+                w2, _ = jax.lax.scan(body, w2, jnp.arange(self.steps_per_epoch))
+                return w2
+
+            in_specs = (wspec, P(WORKERS, FEATURES), P(WORKERS), P(), wspec)
+        else:
+
+            def epoch_shard(w2, idx, val, y, key, ds):
+                key = jax.random.fold_in(key, jax.lax.axis_index(WORKERS))
+
+                def body(c, s):
+                    return self._step(c, idx, val, y, key, s, ds), ()
+
+                w2, _ = jax.lax.scan(body, w2, jnp.arange(self.steps_per_epoch))
+                return w2
+
+            in_specs = (wspec, P(WORKERS, None), P(WORKERS, None), P(WORKERS),
+                        P(), wspec)
+
         self._epoch = jax.jit(
             jax.shard_map(
-                epoch_shard,
-                mesh=self.mesh,
-                in_specs=(P(FEATURES, None),) + dspec + (P(),),
-                out_specs=P(FEATURES, None),
+                epoch_shard, mesh=self.mesh, in_specs=in_specs, out_specs=wspec
             )
         )
         return self
@@ -149,7 +227,9 @@ class FeatureShardedEngine:
         )
 
     def epoch(self, w2: jax.Array, key: jax.Array) -> jax.Array:
-        return self._epoch(w2, self._idx, self._val, self._y, key)
+        if self.dense:
+            return self._epoch(w2, self._val, self._y, key, self._ds)
+        return self._epoch(w2, self._idx, self._val, self._y, key, self._ds)
 
     def to_dense(self, w2: jax.Array) -> np.ndarray:
         return np.asarray(w2).reshape(-1)[: self.model.n_features]

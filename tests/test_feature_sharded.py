@@ -54,10 +54,66 @@ def test_weight_shard_is_local_fraction():
     assert eng.r_total * 128 >= d
 
 
-def test_dim_sparsity_regularizer_rejected():
-    d = 256
-    model = SparseSVM(lam=1e-3, n_features=d,
-                      dim_sparsity=jnp.asarray(np.full(d, 0.01, np.float32)))
-    with pytest.raises(NotImplementedError):
-        FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=4,
-                             learning_rate=0.1)
+def test_dim_sparsity_matches_dp_engine_trajectory():
+    """The flagship reference-exact model (dim_sparsity regularizer,
+    SparseSVM.scala:31) trains feature-sharded: the global w . dimSparsity
+    dot is one scalar psum over 'features' (VERDICT r3 item 4)."""
+    d = 700
+    data = rcv1_like(64, n_features=d, nnz=9, seed=2)
+    rng = np.random.default_rng(8)
+    ds = np.abs(rng.normal(size=d)).astype(np.float32) * 0.01
+    model = SparseSVM(lam=1e-3, n_features=d, dim_sparsity=jnp.asarray(ds))
+    key = jax.random.PRNGKey(3)
+
+    tp = FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=4,
+                              learning_rate=0.3).bind(data)
+    w2 = tp.init_weights()
+    for e in range(2):
+        w2 = tp.epoch(w2, jax.random.fold_in(key, e))
+    got = tp.to_dense(w2)
+
+    dp = SyncEngine(model, make_mesh(2), batch_size=4, learning_rate=0.3).bind(data)
+    w = jnp.zeros(d, dtype=jnp.float32)
+    for e in range(2):
+        w = dp.epoch(w, jax.random.fold_in(key, e))
+    want = np.asarray(w)
+
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    assert np.any(got != 0.0)
+
+
+@pytest.mark.parametrize("regularizer", ["l2", "dim_sparsity"])
+def test_dense_layout_matches_dp_engine_trajectory(regularizer):
+    """Dense-layout datasets run the same dp x tp semantics with the
+    gather/scatter collapsed to plain matmuls over column tiles — for both
+    the l2 and the flagship dim_sparsity regularizer (the g != 0 support
+    mask interacting with the column-tiled gradient)."""
+    from distributed_sgd_tpu.data.rcv1 import Dataset
+
+    d, n = 300, 64
+    rng = np.random.default_rng(12)
+    vals = (rng.random((n, d)) * (rng.random((n, d)) < 0.3)).astype(np.float32)
+    labels = np.where(rng.random(n) < 0.5, 1, -1).astype(np.int32)
+    data = Dataset.dense(vals, labels)
+    if regularizer == "dim_sparsity":
+        ds = np.abs(rng.normal(size=d)).astype(np.float32) * 0.01
+        model = SparseSVM(lam=1e-3, n_features=d, dim_sparsity=jnp.asarray(ds))
+    else:
+        model = SparseSVM(lam=1e-3, n_features=d, regularizer="l2")
+    key = jax.random.PRNGKey(4)
+
+    tp = FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=4,
+                              learning_rate=0.3).bind(data)
+    w2 = tp.init_weights()
+    for e in range(2):
+        w2 = tp.epoch(w2, jax.random.fold_in(key, e))
+    got = tp.to_dense(w2)
+
+    dp = SyncEngine(model, make_mesh(2), batch_size=4, learning_rate=0.3).bind(data)
+    w = jnp.zeros(d, dtype=jnp.float32)
+    for e in range(2):
+        w = dp.epoch(w, jax.random.fold_in(key, e))
+    want = np.asarray(w)
+
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    assert np.any(got != 0.0)
