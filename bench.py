@@ -249,7 +249,7 @@ def main() -> None:
     model_wire10 = model["compute"] + model["reduce"] + model["wire"] / 10.0
     model_no_wire = model["compute"] + model["reduce"]
 
-    print(json.dumps({
+    result = {
         "metric": "rcv1_sync_epoch_seconds",
         "value": round(epoch_s, 4),
         "unit": "s",
@@ -271,7 +271,21 @@ def main() -> None:
         "batch_size": BATCH,
         "n_workers": N_WORKERS,
         "steps_per_epoch": STEPS_PER_EPOCH,
-    }))
+    }
+    print(json.dumps(result))
+
+    # round-over-round regression gate (benches/regress.py, the ScalaMeter
+    # RegressionReporter equivalent): compare against stored history with
+    # shared-chip-variance tolerance, then append this run.  Verdict goes
+    # to stderr; the stdout contract stays ONE JSON line, and a regression
+    # never fails the bench itself (the gate command does that:
+    # `python bench.py | python benches/regress.py gate`).
+    try:
+        from benches import regress
+
+        regress.gate(result)
+    except Exception as e:  # noqa: BLE001 - gating must not break the bench
+        log(f"regression gate skipped: {e}")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,161 @@
+"""Round-over-round performance regression gate (VERDICT r3 item 7).
+
+The reference ships a ScalaMeter regression reporter (ExponentialBackoff
+historian + RegressionReporter, src/test/scala/epfl/distributed/math/
+SparseBench.scala:9-15): every bench run is compared against stored
+history and flagged when it regresses beyond a confidence window.  The
+TPU equivalent: a JSON history of every round's kernel/step/epoch numbers
+(`benches/history.json`, committed) and a gate that compares a fresh run
+against the MEDIAN of the stored runs with a shared-chip-variance
+tolerance (the tunnel TPU is multi-tenant; BASELINE.md records 0.17-0.21 s
+epoch spread across rounds, ~±20%, so the default tolerance is 35%).
+
+Usage:
+    python bench.py | python benches/regress.py gate      # check + append
+    python benches/regress.py gate --no-record < run.json # check only
+    python benches/regress.py show                        # print history
+
+`gate` reads one JSON object on stdin (bench.py's output line), checks
+every numeric field it has history for, appends the run to the history
+(unless --no-record), prints a verdict line per metric to stderr, and
+exits 1 if any metric regressed.  bench.py also appends its run directly
+(see its main()), so driver-invoked rounds accumulate history without a
+pipeline change.
+
+Direction is inferred from the metric name: `*_seconds`/`*_s` are
+lower-is-better, `vs_*`/`*_per_s`/`*_acc` are higher-is-better; anything
+else is recorded but not gated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+HISTORY = os.path.join(os.path.dirname(os.path.abspath(__file__)), "history.json")
+DEFAULT_TOLERANCE = 0.35  # shared-chip variance headroom
+
+
+def direction(name: str) -> Optional[str]:
+    """'down' = lower is better, 'up' = higher is better, None = don't gate."""
+    if name.endswith(("_seconds", "_s")) or name == "value":
+        return "down"
+    if name.startswith("vs_") or name.endswith(("_per_s", "_acc")):
+        return "up"
+    return None
+
+
+def numeric_fields(run: Dict) -> Dict[str, float]:
+    return {
+        k: float(v) for k, v in run.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+
+
+def load_history(path: str = HISTORY) -> List[Dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_history(history: List[Dict], path: str = HISTORY) -> None:
+    with open(path, "w") as f:
+        json.dump(history, f, indent=1)
+        f.write("\n")
+
+
+def median(xs: List[float]) -> float:
+    s = sorted(xs)
+    m = len(s) // 2
+    return s[m] if len(s) % 2 else 0.5 * (s[m - 1] + s[m])
+
+
+def check(
+    run: Dict,
+    history: List[Dict],
+    tolerance: float = DEFAULT_TOLERANCE,
+) -> Tuple[List[str], List[str]]:
+    """Compare `run` against the metric-wise MEDIAN of `history`.
+
+    Returns (regressions, report_lines).  A metric regresses when it is
+    worse than the median by more than `tolerance` (relative).  Metrics
+    with no direction, no history, or a zero median are reported as
+    ungated.
+    """
+    fields = numeric_fields(run)
+    regressions: List[str] = []
+    lines: List[str] = []
+    for name, value in sorted(fields.items()):
+        d = direction(name)
+        prior = [numeric_fields(h)[name] for h in history if name in numeric_fields(h)]
+        if d is None or not prior:
+            lines.append(f"  {name} = {value:g} (not gated)")
+            continue
+        med = median(prior)
+        if med == 0:
+            lines.append(f"  {name} = {value:g} (zero median, not gated)")
+            continue
+        ratio = value / med
+        bad = ratio > 1 + tolerance if d == "down" else ratio < 1 / (1 + tolerance)
+        tag = "REGRESSED" if bad else "ok"
+        lines.append(
+            f"  {name} = {value:g} vs median {med:g} over {len(prior)} run(s) "
+            f"[{d}, x{ratio:.2f}] {tag}"
+        )
+        if bad:
+            regressions.append(name)
+    return regressions, lines
+
+
+def record(run: Dict, path: str = HISTORY) -> None:
+    history = load_history(path)
+    history.append(run)
+    save_history(history, path)
+
+
+def gate(run: Dict, path: str = HISTORY, tolerance: float = DEFAULT_TOLERANCE,
+         do_record: bool = True) -> int:
+    """Check + optionally append; returns the exit code."""
+    history = load_history(path)
+    regressions, lines = check(run, history, tolerance)
+    metric = run.get("metric", "?")
+    print(f"regression gate for {metric!r} vs {len(history)} stored run(s), "
+          f"tolerance {tolerance:.0%}:", file=sys.stderr)
+    for ln in lines:
+        print(ln, file=sys.stderr)
+    if do_record:
+        record(run, path)
+        print(f"run appended to {path}", file=sys.stderr)
+    if regressions:
+        print(f"FAIL: regressed metrics: {', '.join(regressions)}", file=sys.stderr)
+        return 1
+    print("PASS", file=sys.stderr)
+    return 0
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] not in ("gate", "show"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    if argv[0] == "show":
+        for run in load_history():
+            print(json.dumps(run))
+        return 0
+    tolerance = DEFAULT_TOLERANCE
+    do_record = "--no-record" not in argv
+    for i, a in enumerate(argv):
+        if a == "--tolerance":
+            try:
+                tolerance = float(argv[i + 1])
+            except (IndexError, ValueError):
+                print("--tolerance needs a numeric value", file=sys.stderr)
+                return 2
+    run = json.loads(sys.stdin.read())
+    return gate(run, tolerance=tolerance, do_record=do_record)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -20,8 +20,18 @@ def rcv1_like(
     nnz: int = 76,
     noise: float = 0.05,
     seed: int = 0,
+    idf_values: bool = False,
 ) -> Dataset:
-    """Planted-separator sparse classification data, packed [N, P]."""
+    """Planted-separator sparse classification data, packed [N, P].
+
+    `idf_values=True` weights each entry by its feature's inverse document
+    frequency (log(N/df)) before the cosine normalization — the ltc
+    (log-TF x IDF, cosine) scheme the REAL RCV1-v2 vectors use (LYRL2004).
+    Without it, head (Zipf-popular) features carry the same magnitude
+    distribution as tail ones, which real term weighting never allows —
+    the difference that decides whether the reference's lr=0.5 converges
+    smoothly (see BASELINE.md, Zipf-oscillation study).
+    """
     rng = np.random.default_rng(seed)
     # Zipf-ish feature popularity like term frequencies
     pop = 1.0 / np.arange(1, n_features + 1, dtype=np.float64)
@@ -29,6 +39,10 @@ def rcv1_like(
     idx = rng.choice(n_features, size=(n_samples, nnz), p=pop).astype(np.int32)
     idx.sort(axis=1)
     val = np.abs(rng.normal(size=(n_samples, nnz))).astype(np.float32)
+    if idf_values:
+        df = np.bincount(idx.ravel(), minlength=n_features)
+        idf = np.log(n_samples / np.maximum(df, 1.0)).astype(np.float32)
+        val *= np.maximum(idf, 0.0)[idx]
     # real RCV1 rows (and the reference's Map-backed vectors) cannot hold
     # duplicate feature ids: zero out repeat draws, leaving inert pad slots
     dup = np.zeros_like(idx, dtype=bool)

@@ -1,0 +1,81 @@
+"""Async modes must CONVERGE, not just run (VERDICT r3 item 1).
+
+The reference's async mode is a training mode (README.md:35): run to the
+full update budget (maxSteps = n * max_epochs, MasterAsync.scala:83, no
+early stopping), its loss should land comparably to a sync run on the
+SAME data and model.  These tests pin that at small scale on the virtual
+CPU mesh; benches/async_convergence.py measures it at RCV1 feature scale
+on the TPU (results in BASELINE.md).
+
+Tolerance note: Hogwild's stale gossip and local-SGD's periodic averaging
+are different optimizers from bulk-synchronous SGD — bitwise equality is
+not the claim.  The claim is "trains to a comparable loss": best smoothed
+test loss within ASYNC_TOL of the sync final on this fixed
+data/seed/budget (and far below the w=0 loss of ~1.0).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import SparseSVM
+from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
+from distributed_sgd_tpu.parallel.local_sgd import LocalSGDEngine
+from distributed_sgd_tpu.parallel.mesh import make_mesh
+from distributed_sgd_tpu.parallel.sync import SyncEngine
+
+D = 2000
+N = 3200
+MAX_EPOCHS = 3  # budget = n_train * 3 local steps
+LR = 0.1
+ASYNC_TOL = 0.12  # |async best smoothed - sync final|, measured headroom ~2x
+
+
+@pytest.fixture(scope="module")
+def setup():
+    data = rcv1_like(N, n_features=D, nnz=12, noise=0.02, seed=21)
+    train, test = train_test_split(data)
+    model = SparseSVM(lam=1e-5, n_features=D,
+                      dim_sparsity=jnp.asarray(dim_sparsity(train)))
+    # sync anchor: same data/model/lr, same epoch budget
+    eng = SyncEngine(model, make_mesh(2), batch_size=32, learning_rate=LR,
+                     virtual_workers=2)
+    btr, bte = eng.bind(train), eng.bind(test)
+    w = jnp.zeros(D, jnp.float32)
+    key = jax.random.PRNGKey(0)
+    for e in range(MAX_EPOCHS):
+        w = btr.epoch(w, jax.random.fold_in(key, e))
+    sync_loss, sync_acc = bte.evaluate(w)
+    assert sync_loss < 0.5, f"sync anchor failed to train: {sync_loss}"
+    return train, test, model, float(sync_loss), float(sync_acc)
+
+
+@pytest.mark.slow
+def test_hogwild_full_budget_converges_to_sync_comparable_loss(setup):
+    train, test, model, sync_loss, _ = setup
+    eng = HogwildEngine(model, n_workers=4, batch_size=32, learning_rate=LR,
+                        check_every=800, backoff_s=0.05, steps_per_dispatch=16)
+    res = eng.fit(train, test, max_epochs=MAX_EPOCHS)  # no criterion: full budget
+    assert res.state.updates >= len(train) * MAX_EPOCHS  # budget exhausted
+    best = float(res.state.loss)  # best smoothed test loss
+    assert np.isfinite(best)
+    assert abs(best - sync_loss) <= ASYNC_TOL, (
+        f"hogwild best smoothed {best:.4f} vs sync final {sync_loss:.4f} "
+        f"(tolerance {ASYNC_TOL})")
+
+
+@pytest.mark.slow
+def test_local_sgd_full_budget_converges_to_sync_comparable_loss(setup):
+    train, test, model, sync_loss, _ = setup
+    eng = LocalSGDEngine(model, make_mesh(4), batch_size=32, learning_rate=LR,
+                         sync_period=8, check_every=800)
+    res = eng.fit(train, test, max_epochs=MAX_EPOCHS)
+    assert res.state.updates >= len(train) * MAX_EPOCHS
+    best = float(res.state.loss)
+    assert np.isfinite(best)
+    assert abs(best - sync_loss) <= ASYNC_TOL, (
+        f"local_sgd best smoothed {best:.4f} vs sync final {sync_loss:.4f} "
+        f"(tolerance {ASYNC_TOL})")

@@ -1,0 +1,76 @@
+"""Regression gate (benches/regress.py) — the ScalaMeter RegressionReporter
+equivalent (SparseBench.scala:9-15): fresh runs are compared against the
+stored history's median with a shared-chip-variance tolerance."""
+
+import json
+
+from benches import regress
+
+
+def _hist(values):
+    return [{"metric": "m", "value": v, "vs_baseline": 100.0} for v in values]
+
+
+def test_pass_within_tolerance():
+    regs, _ = regress.check({"value": 0.23, "vs_baseline": 90.0},
+                            _hist([0.20, 0.21, 0.19]), tolerance=0.35)
+    assert regs == []
+
+
+def test_slower_epoch_regresses():
+    regs, lines = regress.check({"value": 0.30, "vs_baseline": 100.0},
+                                _hist([0.20, 0.21, 0.19]), tolerance=0.35)
+    assert regs == ["value"]  # 0.30 vs median 0.20 = 1.5x > 1.35
+    assert any("REGRESSED" in ln for ln in lines)
+
+
+def test_lower_speedup_regresses():
+    regs, _ = regress.check({"value": 0.20, "vs_baseline": 60.0},
+                            _hist([0.20, 0.20, 0.20]), tolerance=0.35)
+    assert regs == ["vs_baseline"]  # 60 < 100/1.35
+
+
+def test_median_resists_one_outlier():
+    # one anomalous prior run must not poison the comparison point
+    regs, _ = regress.check({"value": 0.21, "vs_baseline": 100.0},
+                            _hist([0.20, 5.0, 0.19]), tolerance=0.35)
+    assert regs == []
+
+
+def test_empty_history_never_fails():
+    regs, lines = regress.check({"value": 9.9}, [], tolerance=0.35)
+    assert regs == [] and any("not gated" in ln for ln in lines)
+
+
+def test_gate_records_and_exits(tmp_path):
+    path = str(tmp_path / "hist.json")
+    run = {"metric": "m", "value": 0.2}
+    assert regress.gate(run, path=path) == 0  # empty history: pass + record
+    assert len(regress.load_history(path)) == 1
+    assert regress.gate({"metric": "m", "value": 0.5}, path=path) == 1  # 2.5x
+    assert len(regress.load_history(path)) == 2  # regressed runs still recorded
+
+
+def test_round123_history_gates_round3_numbers():
+    """A frozen copy of the rounds-1..3 numbers (the values
+    benches/history.json was seeded from) accepts a run at round-3 levels
+    and rejects a 2x slower epoch.  Frozen on purpose: the live history
+    file grows with every bench run, so asserting against it would make
+    this test flip with ordinary benching."""
+    hist = [
+        {"metric": "rcv1_sync_epoch_seconds", "value": 0.1978, "vs_baseline": 74.63},
+        {"metric": "rcv1_sync_epoch_seconds", "value": 0.1945, "vs_baseline": 734.03},
+        {"metric": "rcv1_sync_epoch_seconds", "value": 0.1926, "vs_baseline": 857.61},
+    ]
+    ok, _ = regress.check({"value": 0.20, "vs_baseline": 800.0}, hist)
+    assert ok == []
+    bad, _ = regress.check({"value": 0.45, "vs_baseline": 800.0}, hist)
+    assert "value" in bad
+
+
+def test_non_numeric_and_nested_fields_ignored():
+    run = {"metric": "m", "value": 0.2, "breakdown": {"a": 1}, "kind": "x",
+           "flag": True}
+    fields = regress.numeric_fields(run)
+    assert "breakdown" not in fields and "kind" not in fields
+    assert "flag" not in fields  # bools are not metrics
