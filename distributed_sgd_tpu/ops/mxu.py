@@ -101,7 +101,18 @@ class OneHotBatch:
         return self.gathered_products(w2).reshape(self.batch_size, self.pad_width).sum(-1)
 
     def scatter_add(self, coeff: jax.Array) -> jax.Array:
-        """Blocked sum_b coeff[b] * x_b -> [R, 128] (scatter_add equivalent)."""
+        """Blocked sum_b coeff[b] * x_b -> [R, 128] (scatter_add equivalent).
+
+        Stays the single deep-contraction dot ON MEASUREMENT
+        (benches/scatter_wide.py + BASELINE.md round 4): splitting the
+        contraction into S=4 batched shards (a [4, R, 128]-wide output
+        footprint) runs the ISOLATED scatter 2.4-4x faster at the flagship
+        T=22,800 — but regresses the FUSED training step 11-15% in a
+        same-chip A/B (both the scatter-only reshape and a shared [S,
+        sub, R] one-hot layout feeding gather AND scatter), because the
+        sharded layouts break the iota-compare one-hot fusion the single
+        dot shares with the gather.  Measured rejection, not an estimate.
+        """
         cv = (
             self.values.reshape(self.batch_size, self.pad_width)
             * coeff.astype(jnp.float32)[:, None]

@@ -204,17 +204,19 @@ def test_dim_sparsity_dense_matches_sparse():
     np.testing.assert_allclose(dim_sparsity(dense), dim_sparsity(sparse))
 
 
-def test_feature_sharded_rejects_dense():
-    from distributed_sgd_tpu.parallel.feature_sharded import FeatureShardedEngine
-    from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS  # noqa: F401
-
-    dense, _ = _pair(n=64, d=16)
-    model = make_model("hinge", 1e-3, 16, regularizer="l2")
+def test_feature_sharded_trains_dense():
+    """Dense-layout data trains feature-sharded (round 4; the engine used
+    to reject it — full parity coverage lives in tests/test_feature_sharded
+    .py::test_dense_layout_matches_dp_engine_trajectory)."""
     import jax as _jax
     from jax.sharding import Mesh
 
+    from distributed_sgd_tpu.parallel.feature_sharded import FeatureShardedEngine
+
+    dense, _ = _pair(n=64, d=16)
+    model = make_model("hinge", 1e-3, 16, regularizer="l2")
     devs = np.array(_jax.devices()[:4]).reshape(2, 2)
     mesh = Mesh(devs, ("workers", "features"))
-    eng = FeatureShardedEngine(model, mesh, batch_size=4, learning_rate=0.1)
-    with pytest.raises(NotImplementedError, match="dense"):
-        eng.bind(dense)
+    eng = FeatureShardedEngine(model, mesh, batch_size=4, learning_rate=0.1).bind(dense)
+    w2 = eng.epoch(eng.init_weights(), _jax.random.PRNGKey(0))
+    assert np.all(np.isfinite(eng.to_dense(w2)))
