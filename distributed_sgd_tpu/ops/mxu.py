@@ -104,14 +104,17 @@ class OneHotBatch:
         """Blocked sum_b coeff[b] * x_b -> [R, 128] (scatter_add equivalent).
 
         Stays the single deep-contraction dot ON MEASUREMENT
-        (benches/scatter_wide.py + BASELINE.md round 4): splitting the
+        (benches/scatter_wide.py + BASELINE.md round 4, raw JSON in
+        benches/results/scatter_{crossover,fused_ab}.json): splitting the
         contraction into S=4 batched shards (a [4, R, 128]-wide output
-        footprint) runs the ISOLATED scatter 2.4-4x faster at the flagship
-        T=22,800 — but regresses the FUSED training step 11-15% in a
-        same-chip A/B (both the scatter-only reshape and a shared [S,
-        sub, R] one-hot layout feeding gather AND scatter), because the
-        sharded layouts break the iota-compare one-hot fusion the single
-        dot shares with the gather.  Measured rejection, not an estimate.
+        footprint) runs the ISOLATED scatter 1.7-4.8x faster below the
+        T ~ 32k crossover (4.8x at the flagship T=22,800) — but regresses
+        the FUSED training step 8-15% in an interleaved same-chip A/B
+        (0.845x for the scatter-only reshape, 0.92x for a shared
+        [S, sub, R] one-hot layout feeding gather AND scatter), because
+        the sharded layouts break the iota-compare one-hot fusion the
+        single dot shares with the gather.  Measured rejection, not an
+        estimate.
         """
         cv = (
             self.values.reshape(self.batch_size, self.pad_width)

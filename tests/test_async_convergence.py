@@ -68,6 +68,28 @@ def test_hogwild_full_budget_converges_to_sync_comparable_loss(setup):
 
 
 @pytest.mark.slow
+def test_rpc_async_full_budget_converges_to_sync_comparable_loss(setup):
+    """The gRPC Hogwild topology (MasterNode.fit_async + WorkerNode k-step
+    gossip over real loopback RPC) is the same algorithm as HogwildEngine —
+    hold it to the same convergence bar."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+
+    train, test, model, sync_loss, _ = setup
+    with DevCluster(model, train, test, n_workers=2,
+                    steps_per_dispatch=8) as c:
+        res = c.master.fit_async(
+            max_epochs=MAX_EPOCHS, batch_size=32, learning_rate=LR,
+            check_every=800, backoff_s=0.05,
+        )
+    assert res.state.updates >= len(train) * MAX_EPOCHS
+    best = float(res.state.loss)
+    assert np.isfinite(best)
+    assert abs(best - sync_loss) <= ASYNC_TOL, (
+        f"rpc async best smoothed {best:.4f} vs sync final {sync_loss:.4f} "
+        f"(tolerance {ASYNC_TOL})")
+
+
+@pytest.mark.slow
 def test_local_sgd_full_budget_converges_to_sync_comparable_loss(setup):
     train, test, model, sync_loss, _ = setup
     eng = LocalSGDEngine(model, make_mesh(4), batch_size=32, learning_rate=LR,

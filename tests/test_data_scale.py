@@ -76,19 +76,17 @@ def test_native_pack_matches_numpy_fallback(corpus_dir, monkeypatch):
         np.testing.assert_array_equal(n_val, p_val)
 
 
-def test_text_corpus_to_convergence_end_to_end(tmp_path):
-    """The full loop the reference runs on real RCV1 — text files on disk
-    -> parse -> pack -> train -> accuracy — converges on a corpus written
-    in the reference's format (planted separator + 5% label noise; the
-    closest no-egress stand-in for real-RCV1 convergence, BASELINE.md)."""
+@pytest.fixture(scope="module")
+def small_corpus(tmp_path_factory):
+    """One shared 8000-row learnable corpus (planted separator + 5% label
+    noise, the reference's exact text format) for the end-to-end loops —
+    parsed once, like the session-scoped full-scale corpus_dir above."""
     import jax.numpy as jnp
 
-    from distributed_sgd_tpu.core.trainer import SyncTrainer
     from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
     from distributed_sgd_tpu.models.linear import make_model
-    from distributed_sgd_tpu.parallel.mesh import make_mesh
 
-    d = str(tmp_path / "corpus")
+    d = str(tmp_path_factory.mktemp("small_corpus"))
     write_rcv1_corpus(d, n_rows=8000, n_train=6400, n_template=2048,
                       nnz_mean=40, n_features=2048, seed=7)
     ds = load_rcv1(d, full=True, n_features=2048)
@@ -96,8 +94,38 @@ def test_text_corpus_to_convergence_end_to_end(tmp_path):
     train, test = train_test_split(ds)
     model = make_model("hinge", 1e-5, 2048,
                        dim_sparsity=jnp.asarray(dim_sparsity(train)))
+    return train, test, model
+
+
+def test_text_corpus_to_convergence_end_to_end(small_corpus):
+    """The full loop the reference runs on real RCV1 — text files on disk
+    -> parse -> pack -> train -> accuracy — converges on a corpus written
+    in the reference's format (planted separator + 5% label noise; the
+    closest no-egress stand-in for real-RCV1 convergence, BASELINE.md)."""
+    from distributed_sgd_tpu.core.trainer import SyncTrainer
+    from distributed_sgd_tpu.parallel.mesh import make_mesh
+
+    train, test, model = small_corpus
     trainer = SyncTrainer(model, make_mesh(2), batch_size=64,
                           learning_rate=0.5, kernel="scalar", seed=0)
     res = trainer.fit(train, test, max_epochs=4)
     assert res.test_accuracies[-1] > 0.75, res.test_accuracies
     assert res.losses[-1] < res.losses[0]
+
+
+def test_text_corpus_to_async_convergence_end_to_end(small_corpus):
+    """The same text->parse->pack->train loop through the ASYNC family:
+    Hogwild gossip workers run their full update budget on the parsed
+    corpus and reach a sync-comparable accuracy (round 4 extends the
+    async-convergence proof, tests/test_async_convergence.py, to corpus
+    files on disk)."""
+    from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
+
+    train, test, model = small_corpus
+    eng = HogwildEngine(model, n_workers=2, batch_size=64, learning_rate=0.5,
+                        check_every=2000, backoff_s=0.05,
+                        steps_per_dispatch=16)
+    res = eng.fit(train, test, max_epochs=2)  # full budget: 2 * 6400 steps
+    assert res.state.updates >= len(train) * 2
+    assert res.test_accuracies[-1] > 0.75, res.test_accuracies
+    assert np.isfinite(res.state.loss)

@@ -1,0 +1,113 @@
+"""True multi-PROCESS RPC cluster: master + workers as separate OS
+processes through the real entry point.
+
+The reference's only multi-node test vehicle is its dev mode looping gRPC
+through one JVM (Main.scala:143-158); tests/test_control_plane.py mirrors
+that (DevCluster, one process).  This test goes one step further than the
+reference ever did: three `python -m distributed_sgd_tpu.main` processes —
+role selection via DSGD_MASTER_HOST/PORT equality (Main.scala:122-159
+parity) — form a cluster over localhost TCP, run a sync fit, and the
+master reports the result.  Every process loads the same synthetic data
+from the shared seed, exactly how reference nodes each read the same
+corpus from disk.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+def _free_ports(n: int) -> list:
+    """n distinct free ports: all allocation sockets held open together so
+    no two picks collide (a close-then-rebind probe races against the
+    sibling processes launched moments later)."""
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _env(host_port: int, master_port: int, extra=None) -> dict:
+    env = os.environ.copy()
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DSGD_SYNTHETIC": "300",
+        "DSGD_NODE_HOST": "127.0.0.1",
+        "DSGD_NODE_PORT": str(host_port),
+        "DSGD_MASTER_HOST": "127.0.0.1",
+        "DSGD_MASTER_PORT": str(master_port),
+        "DSGD_NODE_COUNT": "2",
+        "DSGD_MAX_EPOCHS": "2",
+        "DSGD_BATCH_SIZE": "16",
+        "DSGD_SEED": "0",
+    })
+    env.update(extra or {})
+    return env
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["sync", "async"])
+def test_three_process_fit(mode, tmp_path):
+    extra = (
+        {"DSGD_ASYNC": "1", "DSGD_CHECK_EVERY": "50", "DSGD_CONV_DELTA": "0"}
+        if mode == "async" else {}
+    )
+    master_port, *worker_ports = _free_ports(3)
+    cmd = [sys.executable, "-m", "distributed_sgd_tpu.main"]
+    procs = []
+    worker_logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    try:
+        master = subprocess.Popen(
+            cmd, env=_env(master_port, master_port, extra),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        procs.append(master)
+        for port, logf in zip(worker_ports, worker_logs):
+            w = subprocess.Popen(
+                cmd, env=_env(port, master_port, extra),
+                stdout=open(logf, "w"), stderr=subprocess.STDOUT,
+            )
+            procs.append(w)
+
+        def diag(out):
+            tails = "\n".join(
+                f"== {f.name}:\n{f.read_text()[-1200:]}" for f in worker_logs
+                if f.exists())
+            return f"{out[-3000:]}\n{tails}"
+
+        try:
+            out, _ = master.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            master.kill()
+            out, _ = master.communicate()
+            raise AssertionError(f"master timed out:\n{diag(out)}")
+        assert master.returncode == 0, diag(out)
+        assert "fit done:" in out, diag(out)
+        assert "final test loss=" in out, diag(out)
+        if mode == "sync":
+            assert "fit done: 2 epochs" in out, diag(out)
+        else:  # budget counted in local steps across real processes
+            assert ("max number of steps reached" in out
+                    or "converged" in out), diag(out)
+    finally:
+        deadline = time.time() + 10
+        for p in procs[1:]:  # workers run until terminated
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
