@@ -38,7 +38,10 @@ def _force_sync_dispatch():
 def rcv1_scale(n, seed=0):
     from distributed_sgd_tpu.data.synthetic import rcv1_like
 
-    return rcv1_like(n, n_features=47236, nnz=76, seed=seed)
+    # ltc/IDF value weighting — the realistic model of RCV1-v2 term
+    # weighting; the reference's lr=0.5 is only smooth with it
+    # (benches/zipf_oscillation.py, BASELINE.md round 4)
+    return rcv1_like(n, n_features=47236, nnz=76, seed=seed, idf_values=True)
 
 
 def _sync_run(data, model_name, workers, batch, lr, lam, reg, epochs=2):

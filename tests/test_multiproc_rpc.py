@@ -12,6 +12,7 @@ from the shared seed, exactly how reference nodes each read the same
 corpus from disk.
 """
 
+import contextlib
 import os
 import signal
 import socket
@@ -20,6 +21,8 @@ import sys
 import time
 
 import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _free_ports(n: int) -> list:
@@ -41,6 +44,7 @@ def _free_ports(n: int) -> list:
 def _env(host_port: int, master_port: int, extra=None) -> dict:
     env = os.environ.copy()
     env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         "JAX_PLATFORMS": "cpu",
         "DSGD_SYNTHETIC": "300",
         "DSGD_NODE_HOST": "127.0.0.1",
@@ -68,38 +72,40 @@ def test_three_process_fit(mode, tmp_path):
     procs = []
     worker_logs = [tmp_path / f"worker{i}.log" for i in range(2)]
     try:
-        master = subprocess.Popen(
-            cmd, env=_env(master_port, master_port, extra),
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        procs.append(master)
-        for port, logf in zip(worker_ports, worker_logs):
-            w = subprocess.Popen(
-                cmd, env=_env(port, master_port, extra),
-                stdout=open(logf, "w"), stderr=subprocess.STDOUT,
+        with contextlib.ExitStack() as stack:
+            master = subprocess.Popen(
+                cmd, env=_env(master_port, master_port, extra),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             )
-            procs.append(w)
+            procs.append(master)
+            for port, logf in zip(worker_ports, worker_logs):
+                w = subprocess.Popen(
+                    cmd, env=_env(port, master_port, extra),
+                    stdout=stack.enter_context(open(logf, "w")),
+                    stderr=subprocess.STDOUT,
+                )
+                procs.append(w)
 
-        def diag(out):
-            tails = "\n".join(
-                f"== {f.name}:\n{f.read_text()[-1200:]}" for f in worker_logs
-                if f.exists())
-            return f"{out[-3000:]}\n{tails}"
+            def diag(out):
+                tails = "\n".join(
+                    f"== {f.name}:\n{f.read_text()[-1200:]}" for f in worker_logs
+                    if f.exists())
+                return f"{out[-3000:]}\n{tails}"
 
-        try:
-            out, _ = master.communicate(timeout=420)
-        except subprocess.TimeoutExpired:
-            master.kill()
-            out, _ = master.communicate()
-            raise AssertionError(f"master timed out:\n{diag(out)}")
-        assert master.returncode == 0, diag(out)
-        assert "fit done:" in out, diag(out)
-        assert "final test loss=" in out, diag(out)
-        if mode == "sync":
-            assert "fit done: 2 epochs" in out, diag(out)
-        else:  # budget counted in local steps across real processes
-            assert ("max number of steps reached" in out
-                    or "converged" in out), diag(out)
+            try:
+                out, _ = master.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                master.kill()
+                out, _ = master.communicate()
+                raise AssertionError(f"master timed out:\n{diag(out)}")
+            assert master.returncode == 0, diag(out)
+            assert "fit done:" in out, diag(out)
+            assert "final test loss=" in out, diag(out)
+            if mode == "sync":
+                assert "fit done: 2 epochs" in out, diag(out)
+            else:  # budget counted in local steps across real processes
+                assert ("max number of steps reached" in out
+                        or "converged" in out), diag(out)
     finally:
         deadline = time.time() + 10
         for p in procs[1:]:  # workers run until terminated
