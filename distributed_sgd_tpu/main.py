@@ -53,7 +53,10 @@ def load_data(cfg: Config) -> Dataset:
     if synthetic or not os.path.exists(train_file):
         n = int(synthetic or 100_000)
         log.info("RCV1 not found or DSGD_SYNTHETIC set: generating %d synthetic rows", n)
-        return rcv1_like(n, seed=cfg.seed)
+        # ltc/IDF value weighting, like real RCV1-v2 term weighting — the
+        # shipped default lr=0.5 only descends smoothly with it
+        # (benches/zipf_oscillation.py, BASELINE.md round 4)
+        return rcv1_like(n, seed=cfg.seed, idf_values=True)
     return load_rcv1(cfg.data_path, full=cfg.full, pad_width=cfg.pad_width)
 
 

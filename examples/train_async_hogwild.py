@@ -20,7 +20,7 @@ from distributed_sgd_tpu.parallel.hogwild import HogwildEngine  # noqa: E402
 
 
 def main(n: int = 3_000) -> float:
-    data = rcv1_like(n, seed=0)
+    data = rcv1_like(n, seed=0, idf_values=True)  # ltc weighting: smooth at lr=0.5
     train, test = train_test_split(data)
     model = make_model(
         "hinge", 1e-5, data.n_features, dim_sparsity=jnp.asarray(dim_sparsity(train))
