@@ -23,8 +23,14 @@ exits 1 if any metric regressed.  bench.py also appends its run directly
 pipeline change.
 
 Direction is inferred from the metric name: `*_seconds`/`*_s` are
-lower-is-better, `vs_*`/`*_per_s`/`*_acc` are higher-is-better; anything
-else is recorded but not gated.
+lower-is-better, `*_per_s`/`*_acc` are higher-is-better; anything else —
+including the `vs_*` speedup ratios — is recorded but not gated.  The
+ratios couple the TPU number to a baseline floor RE-MEASURED on the bench
+host each run (benches/boxed_baseline.py), so their variance includes the
+host's; a genuine TPU regression already shows in the directly-measured
+`value`, and gating the ratios only adds host-noise false alarms
+(observed: a 123 s floor window vs the 165 s median flagged
+`vs_boxed_floor_workers_parallel` while the epoch itself was in range).
 """
 
 from __future__ import annotations
@@ -39,11 +45,23 @@ DEFAULT_TOLERANCE = 0.35  # shared-chip variance headroom
 
 
 def direction(name: str) -> Optional[str]:
-    """'down' = lower is better, 'up' = higher is better, None = don't gate."""
+    """'down' = lower is better, 'up' = higher is better, None = don't gate.
+
+    `vs_*` ratios are deliberately ungated: their denominator is the
+    boxed-map floor re-measured on the bench HOST each run, so the ratio's
+    variance includes host noise that `value` (the direct TPU measurement)
+    does not (see module docstring)."""
+    # host-measured quantities (the boxed floor, JVM-model scalars) are
+    # recorded but never gated: their variance is the bench HOST's, not the
+    # framework's — the same reason the vs_* ratios are ungated
+    if "floor" in name or "jvm" in name:
+        return None
+    # rate suffixes first: "*_per_s" would otherwise match the "_s"
+    # lower-is-better check and gate throughput backwards
+    if name.endswith(("_per_s", "_acc")):
+        return "up"
     if name.endswith(("_seconds", "_s")) or name == "value":
         return "down"
-    if name.startswith("vs_") or name.endswith(("_per_s", "_acc")):
-        return "up"
     return None
 
 

@@ -24,10 +24,33 @@ def test_slower_epoch_regresses():
     assert any("REGRESSED" in ln for ln in lines)
 
 
-def test_lower_speedup_regresses():
-    regs, _ = regress.check({"value": 0.20, "vs_baseline": 60.0},
-                            _hist([0.20, 0.20, 0.20]), tolerance=0.35)
-    assert regs == ["vs_baseline"]  # 60 < 100/1.35
+def test_vs_ratios_are_informational_not_gated():
+    """`vs_*` ratios couple the TPU epoch to the HOST-measured floor, so
+    host variance would false-alarm them; only direct measurements gate
+    (a collapsed ratio with an in-range `value` must pass)."""
+    regs, lines = regress.check({"value": 0.20, "vs_baseline": 60.0},
+                                _hist([0.20, 0.20, 0.20]), tolerance=0.35)
+    assert regs == []
+    assert any("vs_baseline" in ln and "not gated" in ln for ln in lines)
+
+
+def test_lower_throughput_regresses():
+    hist = [{"metric": "m", "value": 0.2, "updates_per_s": 400.0}] * 3
+    regs, _ = regress.check({"value": 0.2, "updates_per_s": 200.0}, hist,
+                            tolerance=0.35)
+    assert regs == ["updates_per_s"]  # 200 < 400/1.35: up-gated metric
+
+
+def test_host_measured_floor_never_gates():
+    """The boxed floor is measured on the bench HOST each run (123-259 s
+    swing observed); a slow host window must not fail the gate when the
+    TPU measurement itself is in range."""
+    hist = [{"metric": "m", "value": 0.2, "boxed_floor_epoch_seconds": 154.0}] * 3
+    regs, lines = regress.check(
+        {"value": 0.2, "boxed_floor_epoch_seconds": 230.0}, hist,
+        tolerance=0.35)
+    assert regs == []
+    assert any("boxed_floor" in ln and "not gated" in ln for ln in lines)
 
 
 def test_median_resists_one_outlier():
