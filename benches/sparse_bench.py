@@ -14,7 +14,14 @@ benches the same five ops over RCV1-shaped rows in three implementations:
 - `boxed`: per-row python dict arithmetic, the reference's cost model
   (boxed per-entry ops, fresh map per operation).
 
-Usage: python benches/sparse_bench.py [n_rows]
+Usage: python benches/sparse_bench.py [n_rows] [--gate]
+
+`--gate` additionally emits one flat JSON line and runs it through the
+round-over-round regression harness (benches/regress.py) against the
+kernel history — the reference wraps exactly this bench in ScalaMeter's
+RegressionReporter (SparseBench.scala:9-15).  Only the framework's own
+kernel timings (`xla_*`/`xla_flat_*`, `*_s` keys) gate; the scipy/boxed
+comparison baselines are recorded as ungated `*_baseline` keys.
 """
 
 from __future__ import annotations
@@ -152,7 +159,9 @@ def bench_boxed(idx, val, w):
 
 
 def main() -> None:
-    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 100  # SparseBench.scala:22
+    # first non-flag argument is n_rows (SparseBench.scala:22 default 100)
+    args = [a for a in sys.argv[1:] if not a.startswith("-")]
+    n_rows = int(args[0]) if args else 100
     idx, val = make_rows(n_rows)
     w = np.random.default_rng(1).random(47236).astype(np.float32)
 
@@ -167,6 +176,48 @@ def main() -> None:
     print(f"{'op':>14} " + " ".join(f"{k:>12}" for k in results))
     for op in ops:
         print(f"{op:>14} " + " ".join(f"{results[k][op]:12.6f}" for k in results))
+
+    if "--gate" in sys.argv:
+        import json
+        import re
+
+        from benches import regress
+
+        def slug(op):
+            return re.sub(r"[^a-z0-9]+", "_", op.lower()).strip("_")
+
+        run = {"metric": "sparse_kernels", "n_rows": n_rows}
+        for impl, per_op in results.items():
+            for op, secs in per_op.items():
+                # framework kernels gate (lower-is-better _s suffix);
+                # scipy/boxed are host-side comparison baselines: recorded
+                # under an ungated suffix (see regress.direction)
+                suffix = "_s" if impl.startswith("xla") else "_baseline"
+                run[f"{impl}_{slug(op)}{suffix}"] = round(secs, 6)
+        print(json.dumps(run))
+        # tolerance 1.0 (2x): these are tens-of-microsecond timings on a
+        # shared tunnel chip and swing ~2x run to run; the gate exists to
+        # catch structural regressions (an accidental de-jit or a fallback
+        # to the scalar path is 10x+), not dispatch jitter.  History is
+        # per-size (timings scale with n_rows), and — unlike the epoch
+        # gate, which logs every run — a FAILING kernel run is NOT
+        # recorded: appending regressed values would let repeated failing
+        # runs drag the median up until the regression "passes"
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            f"kernel_history_n{n_rows}.json")
+        history = regress.load_history(path)
+        regressions, lines = regress.check(run, history, tolerance=1.0)
+        print(f"kernel gate (n_rows={n_rows}) vs {len(history)} stored "
+              f"run(s), tolerance 100%:", file=sys.stderr)
+        for ln in lines:
+            print(ln, file=sys.stderr)
+        if regressions:
+            print(f"FAIL: regressed kernels: {', '.join(regressions)} "
+                  f"(run NOT recorded)", file=sys.stderr)
+            raise SystemExit(1)
+        regress.record(run, path)
+        print(f"PASS; run appended to {path}", file=sys.stderr)
+        raise SystemExit(0)
 
 
 if __name__ == "__main__":
