@@ -7,6 +7,16 @@ hinge SVM, nodeCount=3 workers (application.conf:15-28), 47,236 features,
 so the run uses synthetic data with RCV1's exact shape statistics (n, d,
 ~76 nnz/row, unit-norm rows).
 
+Generator choice (deliberate): this harness KEEPS the uniform-popularity
+generator so the headline series (epoch seconds, final_loss 0.16/acc 0.94)
+stays comparable across rounds in the driver's BENCH_r records and the
+regression history.  Epoch wall-clock is shape-determined and identical
+across generators; convergence REALISM lives elsewhere — the full-scenario
+and five-config artifacts run on the ltc/IDF generator
+(`rcv1_like(idf_values=True)`, benches/full_scenario.py +
+benches/baseline_configs.py; see BASELINE.md's Zipf-oscillation study for
+why value weighting is what separates the generators).
+
 The TPU side runs the same topology the reference runs: 3 workers, each
 computing a per-batch 100-sample gradient sum + regularize, mean-reduced
 every step (SyncEngine virtual_workers=3 on one chip; on a pod the same
