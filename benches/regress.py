@@ -11,20 +11,30 @@ tolerance (the tunnel TPU is multi-tenant; BASELINE.md records 0.17-0.21 s
 epoch spread across rounds, ~±20%, so the default tolerance is 35%).
 
 Usage:
-    python bench.py | python benches/regress.py gate      # check + append
-    python benches/regress.py gate --no-record < run.json # check only
-    python benches/regress.py show                        # print history
+    python bench.py                                        # gates + appends itself
+    python bench.py | python benches/regress.py gate --no-record  # re-check only
+    python benches/regress.py gate < run.json              # check + append
+    python benches/regress.py show                         # print history
 
 `gate` reads one JSON object on stdin (bench.py's output line), checks
 every numeric field it has history for, appends the run to the history
-(unless --no-record), prints a verdict line per metric to stderr, and
-exits 1 if any metric regressed.  bench.py also appends its run directly
-(see its main()), so driver-invoked rounds accumulate history without a
-pipeline change.
+(unless --no-record; a REGRESSED run is never appended — recording a
+regression would drag the rolling median toward it until it "passes",
+the erosion failure the kernel gate in sparse_bench.py also refuses),
+prints a verdict line per metric to stderr, and exits 1 if any metric
+regressed.  bench.py gates and appends its run directly (see its
+main()), so the pipe form above uses --no-record to avoid gating a
+history that already contains the run under test.
 
-Direction is inferred from the metric name: `*_seconds`/`*_s` are
-lower-is-better, `*_per_s`/`*_acc` are higher-is-better; anything else —
-including the `vs_*` speedup ratios — is recorded but not gated.  The
+History may hold several independent series (the uniform headline, the
+ltc convergence record, ...): entries are compared only against prior
+entries with the SAME top-level `"metric"` name, so one series' `value`
+never pollutes another's median.
+
+Direction is inferred from the metric name: `*_seconds`/`*_s`/`*_loss`
+are lower-is-better, `*_per_s`/`*_acc` are higher-is-better; anything
+else — including the `vs_*` speedup ratios — is recorded but not gated.
+The
 ratios couple the TPU number to a baseline floor RE-MEASURED on the bench
 host each run (benches/boxed_baseline.py), so their variance includes the
 host's; a genuine TPU regression already shows in the directly-measured
@@ -60,7 +70,10 @@ def direction(name: str) -> Optional[str]:
     # lower-is-better check and gate throughput backwards
     if name.endswith(("_per_s", "_acc")):
         return "up"
-    if name.endswith(("_seconds", "_s")) or name == "value":
+    # *_loss gates DOWN: the north star is epoch time AT MATCHED final
+    # loss (BASELINE.md), so the loss half of the pair must gate too —
+    # final_acc alone is an insensitive proxy for a convergence break
+    if name.endswith(("_seconds", "_s", "_loss")) or name == "value":
         return "down"
     return None
 
@@ -102,7 +115,15 @@ def check(
     worse than the median by more than `tolerance` (relative).  Metrics
     with no direction, no history, or a zero median are reported as
     ungated.
+
+    When `run` carries a `"metric"` name, only history entries of the
+    SAME series are compared (entries without a name stay eligible, so
+    synthetic test histories keep working); runs without a name see the
+    whole history unchanged.
     """
+    series = run.get("metric")
+    if series is not None:
+        history = [h for h in history if h.get("metric") in (series, None)]
     fields = numeric_fields(run)
     regressions: List[str] = []
     lines: List[str] = []
@@ -145,8 +166,15 @@ def gate(run: Dict, path: str = HISTORY, tolerance: float = DEFAULT_TOLERANCE,
     for ln in lines:
         print(ln, file=sys.stderr)
     if do_record:
-        record(run, path)
-        print(f"run appended to {path}", file=sys.stderr)
+        if regressions:
+            # a regressed run NEVER enters history: appending it would pull
+            # the rolling median toward the regression until it passes
+            # (sparse_bench.py's kernel gate states the same policy)
+            print(f"run NOT recorded (regressed; history {path} unchanged)",
+                  file=sys.stderr)
+        else:
+            record(run, path)
+            print(f"run appended to {path}", file=sys.stderr)
     if regressions:
         print(f"FAIL: regressed metrics: {', '.join(regressions)}", file=sys.stderr)
         return 1

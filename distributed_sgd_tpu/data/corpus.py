@@ -48,8 +48,7 @@ def _template_bodies(
     idx = rng.choice(n_features, size=(n_template, max_nnz), p=pop).astype(np.int32)
     val = rng.uniform(0.001, 1.0, size=(n_template, max_nnz))
     w_true = rng.normal(size=n_features).astype(np.float64)
-    bodies: List[str] = []
-    margins = np.zeros(n_template)
+    rows: List[Tuple[np.ndarray, np.ndarray]] = []
     for r in range(n_template):
         # slice to this row's draws FIRST, then sort: sorting the full
         # max_nnz row and truncating would leave short rows holding the
@@ -60,8 +59,34 @@ def _template_bodies(
         # the reference, Dataset.scala:24-33): drop duplicate draws
         keep = np.ones(len(row_idx), dtype=bool)
         keep[1:] = row_idx[1:] != row_idx[:-1]
-        row_idx = row_idx[keep]
-        row_val = val[r, : nnz[r]][keep]
+        rows.append((row_idx[keep], val[r, : nnz[r]][keep]))
+
+    # ltc term weighting, like the REAL RCV1-v2 vectors (LYRL2004): weight
+    # each entry by its feature's inverse DOCUMENT frequency over the
+    # template pool (once per row, so df <= n_template and idf >= 0), then
+    # cosine-normalize the row.  Without it Zipf-head features carry
+    # unattenuated values no real term weighting produces and the
+    # reference's lr=0.5 oscillates (BASELINE.md, Zipf-oscillation study).
+    # The small floor keeps a ubiquitous feature's token nonzero in the
+    # text (a 0-valued f:v entry would decode into the reference's map)
+    df = np.zeros(n_features, dtype=np.int64)
+    for row_idx, _ in rows:
+        df[row_idx] += 1
+    idf = np.maximum(np.log(n_template / np.maximum(df, 1.0)), 0.01)
+
+    bodies: List[str] = []
+    margins = np.zeros(n_template)
+    for r, (row_idx, row_val) in enumerate(rows):
+        row_val = row_val * idf[row_idx]
+        row_val /= max(float(np.linalg.norm(row_val)), 1e-12)
+        # drop entries the %.6f text format would round to 0.000000 (a
+        # floored ubiquitous feature over a large row norm): real RCV1
+        # files carry no zero-valued tokens, and the planted margin must
+        # see exactly the values the parser will read back
+        keep = row_val >= 5e-7
+        row_idx, row_val = row_idx[keep], row_val[keep]
+        if len(row_idx) == 0:  # degenerate all-dropped row: keep one token
+            row_idx, row_val = np.array([1], np.int32), np.array([5e-7], np.float64)
         margins[r] = float(np.dot(row_val, w_true[row_idx]))
         bodies.append(
             " ".join(f"{c + 1}:{v:.6f}" for c, v in zip(row_idx, row_val))

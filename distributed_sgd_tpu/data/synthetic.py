@@ -39,14 +39,19 @@ def rcv1_like(
     idx = rng.choice(n_features, size=(n_samples, nnz), p=pop).astype(np.int32)
     idx.sort(axis=1)
     val = np.abs(rng.normal(size=(n_samples, nnz))).astype(np.float32)
-    if idf_values:
-        df = np.bincount(idx.ravel(), minlength=n_features)
-        idf = np.log(n_samples / np.maximum(df, 1.0)).astype(np.float32)
-        val *= np.maximum(idf, 0.0)[idx]
     # real RCV1 rows (and the reference's Map-backed vectors) cannot hold
     # duplicate feature ids: zero out repeat draws, leaving inert pad slots
     dup = np.zeros_like(idx, dtype=bool)
     dup[:, 1:] = idx[:, 1:] == idx[:, :-1]
+    if idf_values:
+        # DOCUMENT frequency: each feature counts once per row (dedup via
+        # the sorted-duplicate mask), so df <= n_samples and idf >= 0 —
+        # collection frequency would exceed n_samples for mid-head Zipf
+        # features and log(N/df) would go negative, zeroing terms real
+        # ltc/IDF (LYRL2004) only down-weights
+        df = np.bincount(idx[~dup], minlength=n_features)
+        idf = np.log(n_samples / np.maximum(df, 1.0)).astype(np.float32)
+        val *= idf[idx]
     val[dup] = 0.0
     val /= np.maximum(np.linalg.norm(val, axis=1, keepdims=True), 1e-12)  # cosine norm
 

@@ -144,6 +144,34 @@ def test_rcv1_like_stats():
     assert 0.35 < (ds.labels == 1).mean() < 0.65
 
 
+def test_idf_uses_document_frequency_not_collection_frequency():
+    """df counts each feature once per ROW (LYRL2004 document frequency),
+    so df <= n_samples and idf = log(N/df) >= 0 with no clamping — under
+    collection frequency a Zipf-head feature drawn more than once per row
+    would push df > N, idf < 0, and a clamp would zero the term entirely
+    (real ltc/IDF only down-weights terms present in <100% of docs)."""
+    n = 40
+    # tiny feature space + high nnz forces heavy duplication: feature 0's
+    # collection count far exceeds n while its document frequency cannot
+    ds = rcv1_like(n, n_features=5, nnz=8, noise=0.0, seed=11,
+                   idf_values=True)
+    # every feature appearing in <100% of docs must keep NONZERO weight
+    # (for seed=11 features 1..4 have docfreq 35/31/25/17 of 40)
+    partial = 0
+    for f in range(5):
+        docfreq = int(np.any(ds.indices == f, axis=1).sum())
+        if 0 < docfreq < n:
+            partial += 1
+            assert ((ds.indices == f) & (ds.values != 0)).any(), \
+                f"idf zeroed feature {f} present in {docfreq}/{n} docs"
+    assert partial >= 3  # the scenario genuinely exercises the property
+    # a feature in EVERY doc has idf = log(N/N) = 0 -> weight exactly 0 is
+    # fine; all weights must be finite and the cosine norm must hold
+    assert np.isfinite(ds.values).all()
+    norms = np.linalg.norm(ds.values, axis=1)
+    np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+
+
 def test_dense_regression_shapes():
     ds = dense_regression(16, n_features=8, seed=0)
     assert ds.values.shape == (16, 8)

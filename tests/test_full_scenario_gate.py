@@ -1,0 +1,76 @@
+"""The gated ltc convergence series (benches/full_scenario.py --gate).
+
+The per-epoch test-loss record is the reference's own convergence
+evidence (Master.scala:201-211); round 4 established the ltc/IDF
+generator as the realistic regime, so its flagship trajectory is
+regression-tracked in benches/history.json as its own `metric` series
+next to the uniform epoch headline (VERDICT r4 item 2)."""
+
+import json
+from types import SimpleNamespace
+
+from benches import full_scenario, regress
+
+
+def _fake_res(test_losses, test_accs):
+    return SimpleNamespace(
+        test_losses=test_losses,
+        test_accuracies=test_accs,
+        epochs_run=len(test_losses),
+    )
+
+
+def test_upward_movement_sums_only_increases():
+    assert full_scenario.upward_movement([0.5, 0.4, 0.45, 0.3]) == \
+        __import__("pytest").approx(0.05)
+    assert full_scenario.upward_movement([0.5, 0.4, 0.3]) == 0.0
+    assert full_scenario.upward_movement([0.5]) == 0.0
+
+
+def test_summary_fields_and_gate_directions():
+    """final_test_loss must gate down and final_test_acc up under
+    regress.py's suffix rules; the counts stay ungated."""
+    s = full_scenario.summarize(_fake_res([0.44, 0.40, 0.39], [0.78, 0.81, 0.82]),
+                                n_rows=804_414)
+    assert s["metric"] == "ltc_full_scenario"
+    assert s["final_test_loss"] == 0.39 and s["final_test_acc"] == 0.82
+    assert s["epochs_run"] == 3 and s["upward_movement"] == 0.0
+    assert regress.direction("final_test_loss") == "down"
+    assert regress.direction("final_test_acc") == "up"
+    assert regress.direction("epochs_run") is None
+    assert regress.direction("upward_movement") is None
+
+
+def test_series_gates_against_own_median_not_headline(tmp_path):
+    """history.json holds BOTH series; the scenario summary must compare
+    only against prior ltc_full_scenario entries."""
+    path = str(tmp_path / "hist.json")
+    regress.save_history([
+        {"metric": "rcv1_sync_epoch_seconds", "value": 0.19, "final_loss": 0.16},
+        {"metric": "ltc_full_scenario", "final_test_loss": 0.39,
+         "final_test_acc": 0.81},
+        {"metric": "ltc_full_scenario", "final_test_loss": 0.40,
+         "final_test_acc": 0.80},
+    ], path)
+    good = full_scenario.summarize(
+        _fake_res([0.44, 0.394], [0.78, 0.812]), n_rows=804_414)
+    assert regress.gate(good, path=path) == 0
+    bad = full_scenario.summarize(
+        _fake_res([0.44, 0.60], [0.78, 0.70]), n_rows=804_414)
+    assert regress.gate(bad, path=path) == 1
+    # the regressed run must not have entered the history
+    hist = regress.load_history(path)
+    assert len(hist) == 4 and hist[-1]["final_test_loss"] == 0.394
+
+
+def test_smoke_run_refuses_flagship_gate(capsys):
+    """A shrunken run exercises the full generate->fit->summarize path on
+    the CPU mesh and must exit 2 on --gate (smoke shapes never enter the
+    flagship history)."""
+    rc = full_scenario.main(["--rows", "1200", "--max-epochs", "1", "--gate"])
+    assert rc == 2
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["metric"] == "ltc_full_scenario"
+    assert summary["n_rows"] == 1200 and summary["epochs_run"] == 1
+    assert 0.0 < summary["final_test_loss"] < 2.0

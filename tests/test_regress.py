@@ -71,7 +71,50 @@ def test_gate_records_and_exits(tmp_path):
     assert regress.gate(run, path=path) == 0  # empty history: pass + record
     assert len(regress.load_history(path)) == 1
     assert regress.gate({"metric": "m", "value": 0.5}, path=path) == 1  # 2.5x
-    assert len(regress.load_history(path)) == 2  # regressed runs still recorded
+    # a REGRESSED run must NOT enter history: recording it would drag the
+    # rolling median toward the regression until it "passes" (the kernel
+    # gate in sparse_bench.py states the same refusal)
+    assert len(regress.load_history(path)) == 1
+    # and the clean run that follows still gates against the clean median
+    assert regress.gate({"metric": "m", "value": 0.21}, path=path) == 0
+    assert len(regress.load_history(path)) == 2
+
+
+def test_final_loss_gates_down():
+    """The north star is epoch time AT MATCHED final loss; a convergence
+    break (loss up beyond tolerance) must fail even when time and acc
+    look fine."""
+    hist = [{"metric": "m", "value": 0.2, "final_loss": 0.16}] * 3
+    regs, _ = regress.check({"value": 0.2, "final_loss": 0.4}, hist,
+                            tolerance=0.35)
+    assert regs == ["final_loss"]
+    regs, _ = regress.check({"value": 0.2, "final_loss": 0.17}, hist,
+                            tolerance=0.35)
+    assert regs == []  # in-range loss passes; LOWER loss is never a failure
+    regs, _ = regress.check({"value": 0.2, "final_loss": 0.05}, hist,
+                            tolerance=0.35)
+    assert regs == []
+
+
+def test_series_isolation_by_metric_name():
+    """history.json holds several series (uniform headline + ltc
+    convergence record); a run compares only against its OWN series —
+    the other series' identically-named fields must not pollute the
+    median."""
+    hist = [
+        {"metric": "epoch", "value": 0.2},
+        {"metric": "epoch", "value": 0.21},
+        {"metric": "convergence", "value": 58.0},
+        {"metric": "convergence", "value": 60.0},
+    ]
+    # 0.22 vs the epoch median 0.205 passes; vs a pooled median it would fail
+    regs, _ = regress.check({"metric": "epoch", "value": 0.22}, hist)
+    assert regs == []
+    regs, _ = regress.check({"metric": "convergence", "value": 59.0}, hist)
+    assert regs == []
+    # and a genuine regression within its own series still fails
+    regs, _ = regress.check({"metric": "epoch", "value": 0.5}, hist)
+    assert regs == ["value"]
 
 
 def test_round123_history_gates_round3_numbers():
