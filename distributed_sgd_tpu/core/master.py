@@ -130,6 +130,10 @@ class MasterNode:
         self._updates = 0
         self._max_steps = 0
         self._async_running = threading.Event()
+        # inverse of _async_running for interruptible sleeps: CLEAR while a
+        # fit runs (so wait(backoff) really sleeps), SET on budget/stop (so
+        # the check loop wakes immediately instead of a full backoff later)
+        self._async_done = threading.Event()
         self._apply = jax.jit(lambda w, d: w - d)
 
         self.server = new_server(port, host="0.0.0.0")
@@ -183,6 +187,7 @@ class MasterNode:
     def stop(self) -> None:
         self._hb_stop.set()
         self._async_running.clear()
+        self._async_done.set()
         self.server.stop(grace=1.0)
         for ch in self._channels.values():
             ch.close()
@@ -591,7 +596,32 @@ class MasterNode:
         checkpointer=None,
         optimizer: Optional[str] = None,
         momentum: float = 0.9,
+        stall_checks: int = 4,
+        max_stall_interventions: int = 3,
+        stall_window_s: Optional[float] = None,
+        startup_grace_s: Optional[float] = None,
     ) -> FitResult:
+        """Async fit with a stall watchdog (superset; the reference counts
+        updates blindly, MasterAsync.scala:164-177, and a dead worker means
+        the budget never completes — the master spins forever re-evaluating
+        frozen weights).  When no update arrives for the stall window, the
+        watchdog probes every assigned worker: the dead are evicted
+        (joining any heartbeat eviction that already happened) and their
+        sample assignments re-issued to survivors via StartAsync with the
+        current weights, so the lifetime budget completes on the
+        survivors; with no survivors — or after `max_stall_interventions`
+        interventions without any progress — the fit aborts cleanly with
+        RuntimeError instead of spinning (the bar fit_sync already set,
+        on_worker_death).
+
+        Window sizing: `stall_window_s` defaults to
+        max(stall_checks x backoff_s, 60) — a short backoff must not arm a
+        sub-compile-time watchdog, because a worker's FIRST dispatch
+        legitimately produces nothing while XLA compiles its k-step
+        program (and a misfired kick replaces the loop and recompiles,
+        making the stall worse).  Before the first update ever arrives the
+        window is `startup_grace_s` (default max(stall_window, 180)) for
+        the same reason.  Tests pass explicit small values."""
         if optimizer is not None and not isinstance(optimizer, str):
             raise ValueError(
                 "the RPC topology ships the optimizer by NAME in "
@@ -607,8 +637,10 @@ class MasterNode:
         self._require_ready()
         if self._async_running.is_set():
             raise RuntimeError("a computation is already running")  # MasterAsync.scala:42
-        stubs = self._stubs()
-        parts = split(len(self.train), len(stubs))
+        members = self._members()
+        parts = split(len(self.train), len(members))
+        # per-worker sample assignment, kept for watchdog reassignment
+        assignments = {key: part for (key, _), part in zip(members, parts)}
         w0 = (
             np.zeros(self.model.n_features, dtype=np.float32)
             if initial_weights is None
@@ -631,58 +663,220 @@ class MasterNode:
                 self._max_steps, self._updates)
             return async_fit_result(
                 checker, w0, t_start, self._updates, batch_size, len(self.train))
+        self._async_done.clear()
         self._async_running.set()
 
-        wmsg = codec.encode_tensor(w0)
-        for stub, part in zip(stubs, parts):  # MasterAsync.scala:52-55
-            stub.StartAsync(
-                pb.StartAsyncRequest(
-                    weights=wmsg,
-                    samples=part.astype(np.int32),
-                    batch_size=batch_size,
-                    learning_rate=learning_rate,
-                    optimizer=optimizer or "",
-                    momentum=momentum,
-                ),
-                timeout=10.0,
-            )
-        self.log.info("waiting for slaves updates")
-
         last_step = self._updates - check_every  # first check runs immediately
-        while self._async_running.is_set():
-            with self._async_lock:
-                updates = self._updates
-                w_now = self._w_async
-            if updates - last_step < check_every:
-                self._async_running.wait(backoff_s)
-                continue
-            raw_loss, raw_acc = self.local_loss(w_now, test=True)
-            stop = checker.check(raw_loss, raw_acc, w_now, step=updates)
-            # counter keeps the reference's toLong truncation quirk
-            # (MasterAsync.scala:126); the histogram carries the real value
-            self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
-            self.metrics.histogram("master.async.loss.value").record(checker.smoothed[0])
-            self.log.info(
-                "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
-                updates, checker.smoothed[0], checker.smoothed_accs[0],
-            )
-            last_step = updates
-            if stop:
-                self.log.info("converged to target: stopping computation")
-                break
-
-        self._end_async(stubs)
+        if stall_window_s is None:
+            stall_window_s = max(max(1, stall_checks) * backoff_s, 60.0)
+        if startup_grace_s is None:
+            startup_grace_s = max(stall_window_s, 180.0)
+        start_updates = self._updates
+        last_progress = self._updates
+        last_progress_t = time.monotonic()
+        interventions = 0
+        # every endpoint that EVER held an assignment gets the end-of-fit
+        # StopAsync broadcast, even if evicted mid-fit: a falsely-evicted
+        # but alive worker must not keep training (and gossiping into the
+        # master) after the fit returns
+        ever_assigned = set(assignments)
+        try:
+            # fan-out INSIDE the try: a worker dying mid-fan-out must still
+            # reach the finally (_end_async_endpoints), or _async_running
+            # stays set forever and the started workers gossip with no stop
+            for key, part in assignments.items():  # MasterAsync.scala:52-55
+                self._start_async_worker(key, part, w0, batch_size,
+                                         learning_rate, optimizer, momentum)
+            self.log.info("waiting for slaves updates")
+            while self._async_running.is_set():
+                with self._async_lock:
+                    updates = self._updates
+                    w_now = self._w_async
+                window = (startup_grace_s if updates == start_updates
+                          else stall_window_s)
+                # heartbeat eviction reaches the async fit HERE: an assigned
+                # worker that lost membership gets its samples re-issued to a
+                # survivor immediately, without waiting for a full stall
+                with self._members_lock:
+                    member_keys = set(self._workers)
+                evicted = [k for k in assignments if k not in member_keys]
+                if evicted:
+                    self.log.warning(
+                        "async fit: %d assigned worker(s) no longer members; "
+                        "reassigning", len(evicted))
+                    self._reassign_async(assignments, evicted, np.asarray(w_now),
+                                         batch_size, learning_rate, optimizer,
+                                         momentum)
+                if updates > last_progress:
+                    last_progress, last_progress_t = updates, time.monotonic()
+                    interventions = 0
+                elif time.monotonic() - last_progress_t > window:
+                    interventions += 1
+                    if interventions > max_stall_interventions:
+                        raise RuntimeError(
+                            f"async fit stalled: no update progress after "
+                            f"{interventions - 1} watchdog interventions "
+                            f"(budget {updates}/{self._max_steps})")
+                    self._async_watchdog(
+                        assignments, np.asarray(w_now), batch_size,
+                        learning_rate, optimizer, momentum)
+                    last_progress_t = time.monotonic()
+                if updates - last_step < check_every:
+                    self._async_done.wait(backoff_s)
+                    continue
+                raw_loss, raw_acc = self.local_loss(w_now, test=True)
+                stop = checker.check(raw_loss, raw_acc, w_now, step=updates)
+                # counter keeps the reference's toLong truncation quirk
+                # (MasterAsync.scala:126); the histogram carries the real value
+                self.metrics.counter("master.async.loss").increment(int(checker.smoothed[0]))
+                self.metrics.histogram("master.async.loss.value").record(checker.smoothed[0])
+                self.log.info(
+                    "loss computed at %d updates: test_loss=%.6f test_acc=%.4f",
+                    updates, checker.smoothed[0], checker.smoothed_accs[0],
+                )
+                last_step = updates
+                if stop:
+                    self.log.info("converged to target: stopping computation")
+                    break
+        finally:
+            self._end_async_endpoints(ever_assigned)
         # BEST weights, not last (MasterAsync.scala:87-94)
         return async_fit_result(
             checker, w0, t_start, self._updates, batch_size, len(self.train))
 
-    def _end_async(self, stubs) -> None:
+    def _end_async_endpoints(self, endpoints) -> None:
+        """StopAsync broadcast to every endpoint that ever held an
+        assignment — members through their live stubs, evicted endpoints
+        through a short-lived channel (best effort; a truly dead process
+        just refuses the connection)."""
         self._async_running.clear()
-        for stub in stubs:  # broadcast stopAsync (MasterAsync.scala:87-94)
+        self._async_done.set()
+        for key in endpoints:
+            with self._members_lock:
+                stub = self._workers.get(key)
             try:
-                stub.StopAsync(pb.Empty(), timeout=5.0)
-            except grpc.RpcError:
+                if stub is not None:
+                    stub.StopAsync(pb.Empty(), timeout=5.0)
+                else:
+                    ch = new_channel(*key)
+                    try:
+                        WorkerStub(ch).StopAsync(pb.Empty(), timeout=5.0)
+                    finally:
+                        ch.close()
+            except (grpc.RpcError, ValueError):
                 pass
+
+    def _start_async_worker(self, key, part, w, batch_size, learning_rate,
+                            optimizer, momentum) -> None:
+        with self._members_lock:
+            stub = self._workers.get(key)
+        if stub is None:
+            raise RuntimeError(f"worker {key[0]}:{key[1]} vanished before StartAsync")
+        # generous deadline: a RE-issued StartAsync first joins the
+        # worker's running loop thread (worker.py start_async), which can
+        # legitimately block for a full in-flight dispatch — a deadline
+        # shorter than that would falsely evict a live survivor while the
+        # handler goes on to start the new loop anyway (orphan training)
+        stub.StartAsync(
+            pb.StartAsyncRequest(
+                weights=codec.encode_tensor(np.asarray(w)),
+                samples=np.asarray(part).astype(np.int32),
+                batch_size=batch_size,
+                learning_rate=learning_rate,
+                optimizer=optimizer or "",
+                momentum=momentum,
+            ),
+            timeout=60.0,
+        )
+
+    def _async_watchdog(self, assignments, w_now, batch_size, learning_rate,
+                        optimizer, momentum) -> None:
+        """No update progress for the stall window: probe every assigned
+        worker, evict the unresponsive, and re-issue their assignments.
+
+        Dead workers fall in two classes: already evicted by the heartbeat
+        loop (no longer members) and newly unresponsive to a Ping (evicted
+        here).  Each dead worker's samples are merged into a survivor's
+        assignment and re-issued via StartAsync with the CURRENT weights —
+        the worker side replaces its running loop on a repeated StartAsync
+        (worker.py start_async), so kicking a live-but-idle worker is safe
+        too.  Raises RuntimeError when nobody is left to carry the budget.
+        """
+        with self._members_lock:
+            member_keys = set(self._workers)
+        dead = [k for k in assignments if k not in member_keys]
+        for key in assignments:
+            if key in dead:
+                continue
+            with self._members_lock:
+                stub = self._workers.get(key)
+            try:
+                if stub is None:
+                    raise ValueError("channel closed")
+                stub.Ping(pb.Empty(), timeout=5.0)
+            except (grpc.RpcError, ValueError) as e:
+                code = e.code() if isinstance(e, grpc.RpcError) else e
+                self.log.warning(
+                    "async watchdog: worker %s:%d unresponsive (%s); "
+                    "declaring dead", key[0], key[1], code)
+                self.unregister_worker(*key)
+                dead.append(key)
+        if not dead:
+            survivors = list(assignments)
+            if not survivors:
+                raise RuntimeError("async fit: all workers lost mid-fit")
+            # every worker answers pings yet nobody gossips: their async
+            # loops are gone (e.g. a restarted process re-registered) —
+            # re-issue every assignment with the current weights
+            self.log.warning(
+                "async watchdog: stalled with %d live workers; re-issuing "
+                "all StartAsync assignments", len(survivors))
+            for key in survivors:
+                self._try_start_async_worker(key, assignments[key], w_now,
+                                             batch_size, learning_rate,
+                                             optimizer, momentum)
+            return
+        self._reassign_async(assignments, dead, w_now, batch_size,
+                             learning_rate, optimizer, momentum)
+
+    def _reassign_async(self, assignments, dead, w_now, batch_size,
+                        learning_rate, optimizer, momentum) -> None:
+        """Merge each dead worker's samples into a survivor's assignment and
+        re-issue StartAsync there with the current weights (the worker side
+        replaces its running loop on a repeated StartAsync).  Raises
+        RuntimeError when no survivor is left to carry the budget."""
+        survivors = [k for k in assignments if k not in dead]
+        if not survivors:
+            raise RuntimeError("async fit: all workers lost mid-fit")
+        targets = []
+        for i, key in enumerate(dead):
+            target = survivors[i % len(survivors)]
+            part = assignments.pop(key)
+            assignments[target] = np.concatenate([assignments[target], part])
+            if target not in targets:
+                targets.append(target)
+            self.log.warning(
+                "async fit: re-issuing %d samples of dead worker "
+                "%s:%d to %s:%d", len(part), key[0], key[1], *target)
+        for target in targets:
+            self._try_start_async_worker(target, assignments[target], w_now,
+                                         batch_size, learning_rate, optimizer,
+                                         momentum)
+
+    def _try_start_async_worker(self, key, part, w, batch_size, learning_rate,
+                                optimizer, momentum) -> None:
+        """Re-issue wrapper: a target that dies in the window between the
+        probe and the StartAsync is evicted instead of aborting the fit —
+        the loop's membership check reassigns its samples next tick."""
+        try:
+            self._start_async_worker(key, part, w, batch_size, learning_rate,
+                                     optimizer, momentum)
+        except (grpc.RpcError, RuntimeError) as e:
+            code = e.code() if isinstance(e, grpc.RpcError) else e
+            self.log.warning(
+                "async fit: StartAsync re-issue to %s:%d failed (%s); "
+                "evicting — samples reassign next tick", key[0], key[1], code)
+            self.unregister_worker(*key)
 
     # master UpdateGrad RPC (MasterAsync.scala:164-177); one gossip message
     # may carry n_steps summed local steps (dispatch amortization) and
@@ -700,6 +894,7 @@ class MasterNode:
         if updates >= self._max_steps and self._async_running.is_set():
             self.log.info("max number of steps reached: stopping computation")
             self._async_running.clear()
+            self._async_done.set()  # wake the check loop immediately
 
     def _require_ready(self) -> None:
         if not self.cluster_ready.is_set():  # withClusterReady barrier

@@ -249,6 +249,13 @@ class WorkerNode:
     def start_async(self, w0: np.ndarray, assignment: np.ndarray, batch_size: int,
                     learning_rate: float, optimizer: str = "",
                     momentum: float = 0.9) -> None:
+        # a re-issued StartAsync (master watchdog reassignment after a peer
+        # death, master.py _async_watchdog) REPLACES any running loop: stop
+        # and join it first so two loops never race on the shared state
+        if self._async_thread is not None and self._async_thread.is_alive():
+            self.log.info("StartAsync re-issued: replacing the running async loop")
+            self._running_async.clear()
+            self._async_thread.join()
         with self._w_lock:
             self._w = jax.device_put(jnp.asarray(w0, dtype=jnp.float32), self.device)
         self._assignment = jax.device_put(

@@ -300,6 +300,7 @@ class HogwildEngine:
         self._apply = jax.jit(lambda w, d: w - d)
         self._stop = threading.Event()
         self._max_steps = 0
+        self._workers: List[_Worker] = []  # live during fit (watchdog + tests)
 
     # master updateGrad RPC (MasterAsync.scala:164-177); one gossip message
     # carries n_steps local steps, and maxSteps counts local steps
@@ -320,7 +321,21 @@ class HogwildEngine:
         max_epochs: int,
         criterion: Optional[Criterion] = None,
         initial_weights: Optional[np.ndarray] = None,
+        stall_timeout_s: float = 60.0,
+        max_restarts: int = 2,
+        startup_grace_s: Optional[float] = None,
     ) -> FitResult:
+        """`stall_timeout_s` arms the watchdog: when no update arrives for
+        that long, dead worker threads (a crashed `_loop`) get their
+        StartAsync re-issued with the CURRENT weights — up to `max_restarts`
+        times each — so the lifetime budget completes on the survivors; a
+        stall with nobody restartable and nobody alive raises RuntimeError
+        instead of spinning forever (the reference's MasterAsync would spin:
+        it counts updates blindly, MasterAsync.scala:164-177).  Before the
+        FIRST update the window is `startup_grace_s` (default
+        max(stall_timeout_s, 180)): the first dispatch legitimately
+        produces nothing while XLA compiles the k-step program, and a
+        misfired restart would recompile and make the stall worse."""
         n = len(train)
         w0 = (
             np.zeros(self.model.n_features, dtype=np.float32)
@@ -363,6 +378,7 @@ class HogwildEngine:
         ]
         for w in workers:
             w.connect(workers, self)
+        self._workers = workers
 
         # master-local test eval (the loss checker's localLoss equivalent)
         eval_bound = SyncEngine(self.model, make_mesh(1), self.batch_size, 0.0).bind(test)
@@ -371,11 +387,54 @@ class HogwildEngine:
             w.start_async(w0)
 
         last_step = self._updates - self.check_every  # first check runs immediately
+        if startup_grace_s is None:
+            startup_grace_s = max(stall_timeout_s, 180.0)
+        restarts = {w.wid: 0 for w in workers}
+        start_updates = self._updates
+        last_progress = self._updates
+        last_progress_t = time.monotonic()
+        interventions = 0
         try:
             while not self._stop.is_set():
                 with self._lock:
                     updates = self._updates
                     w_now = self._w_master
+                window = (startup_grace_s if updates == start_updates
+                          else stall_timeout_s)
+                if updates > last_progress:
+                    last_progress, last_progress_t = updates, time.monotonic()
+                    interventions = 0
+                elif time.monotonic() - last_progress_t > window:
+                    interventions += 1
+                    dead = [w for w in workers
+                            if w._thread is None or not w._thread.is_alive()]
+                    alive = [w for w in workers if w not in dead]
+                    restartable = [w for w in dead
+                                   if restarts[w.wid] < max_restarts]
+                    if not alive and not restartable:
+                        raise RuntimeError(
+                            f"hogwild fit stalled: no live workers and no "
+                            f"restarts left (budget {updates}/{self._max_steps})")
+                    if restartable:
+                        for w in restartable:
+                            restarts[w.wid] += 1
+                            log.warning(
+                                "watchdog: worker %d dead; re-issuing "
+                                "StartAsync with current weights (restart "
+                                "%d/%d)", w.wid, restarts[w.wid], max_restarts)
+                            w.start_async(np.asarray(w_now))
+                        interventions = 0  # a restart earns a fresh window
+                    elif interventions > 3:
+                        # nothing restartable and still no progress: without
+                        # this cap a mix of restart-exhausted dead workers
+                        # and live-but-stalled ones would intervene forever,
+                        # the exact spin this watchdog exists to prevent
+                        raise RuntimeError(
+                            f"hogwild fit stalled after {interventions - 1} "
+                            f"quiet windows ({len(alive)} live worker(s), "
+                            f"{len(dead)} dead, budget "
+                            f"{updates}/{self._max_steps})")
+                    last_progress_t = time.monotonic()
                 if updates - last_step < self.check_every:
                     self._stop.wait(self.backoff_s)
                     continue
@@ -399,6 +458,9 @@ class HogwildEngine:
                 w.stop_async()
             for w in workers:
                 w.join()
+            # release the device-resident shards/replicas: an engine held
+            # alive after fit must not pin n_workers dataset copies
+            self._workers = []
 
         # return BEST weights (MasterAsync.scala:87-94)
         return async_fit_result(

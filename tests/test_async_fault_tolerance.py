@@ -1,0 +1,179 @@
+"""Fault-injection tests for the ASYNC family (VERDICT r4 item 3).
+
+The reference's MasterAsync counts updates blindly (MasterAsync.scala:
+164-177): a dead worker mid-fit means the lifetime budget never completes
+and the master spins forever re-evaluating frozen weights.  Our async fits
+carry the same fault superset the sync fit already had (master.py
+fit_sync): heartbeat eviction reaches the async loop (immediate
+reassignment), a stall watchdog probes and re-issues dead workers'
+StartAsync assignments to survivors, and a fit with nobody left aborts
+cleanly instead of spinning."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.data.rcv1 import train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import LogisticRegression
+from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=33))
+
+
+def _model():
+    return LogisticRegression(lam=1e-5, n_features=128, regularizer="l2")
+
+
+def _hard_kill_async(worker):
+    """Simulate a crash: stop the async loop AND the gRPC server, with no
+    unregister — the master must discover the death itself."""
+    worker._stopped.set()
+    worker._running_async.clear()
+    if worker._async_thread is not None:
+        worker._async_thread.join()
+    worker.server.stop(grace=0)
+
+
+def _await(cond, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _fit_async_in_thread(master, **kwargs):
+    box = {}
+
+    def run():
+        try:
+            box["res"] = master.fit_async(**kwargs)
+        except Exception as e:  # noqa: BLE001 - captured for assertions
+            box["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def test_async_rpc_kill_one_of_three_completes_budget(data):
+    """Kill 1 of 3 RPC workers mid-fit (heartbeat running): the master
+    evicts it, re-issues its samples to a survivor, and the lifetime
+    budget still completes — no infinite spin."""
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=3,
+                    heartbeat_s=0.1) as c:
+        max_epochs = 40
+        t, box = _fit_async_in_thread(
+            c.master, max_epochs=max_epochs, batch_size=8, learning_rate=0.02,
+            check_every=200, backoff_s=0.05, stall_checks=4,
+        )
+        _await(lambda: c.master._updates > 50, msg="first updates")
+        victim = c.workers[0]
+        _hard_kill_async(victim)
+        t.join(timeout=120)
+        assert not t.is_alive(), "fit_async did not terminate"
+        assert "exc" not in box, f"fit_async raised: {box.get('exc')}"
+        res = box["res"]
+        assert res.state.updates >= len(train) * max_epochs
+        # the victim was evicted from membership
+        assert (victim.host, victim.port) not in c.master._workers
+        # its samples were re-issued: some survivor now owns a larger
+        # assignment than the vanilla split gave it
+        survivor_sizes = [
+            int(w._assignment.shape[0]) for w in c.workers[1:]
+            if w._assignment is not None
+        ]
+        base = -(-len(train) // 3)  # ceil: vanilla_split's largest part
+        assert any(s > base for s in survivor_sizes), (
+            f"no survivor absorbed the dead worker's samples: {survivor_sizes}")
+
+
+def test_async_rpc_all_workers_dead_raises_promptly(data):
+    """Kill ALL workers mid-fit: the stall watchdog probes, finds nobody,
+    and the fit raises RuntimeError instead of spinning forever (the
+    reference would spin: MasterAsync.scala:164-177)."""
+    train, test = data
+    with DevCluster(_model(), train, test, n_workers=2) as c:
+        t, box = _fit_async_in_thread(
+            c.master, max_epochs=100_000, batch_size=8, learning_rate=0.02,
+            check_every=10_000, backoff_s=0.05, stall_checks=2,
+            stall_window_s=0.5,  # small on purpose: the test wants promptness
+        )
+        _await(lambda: c.master._updates > 0, msg="first updates")
+        for w in c.workers:
+            _hard_kill_async(w)
+        t.join(timeout=60)
+        assert not t.is_alive(), "fit_async spun instead of aborting"
+        assert isinstance(box.get("exc"), RuntimeError)
+        assert "lost" in str(box["exc"]) or "stalled" in str(box["exc"])
+
+
+def test_hogwild_all_workers_stopped_watchdog_restarts_and_completes():
+    """Stop every Hogwild worker thread mid-fit: the stall watchdog
+    re-issues StartAsync (with the current weights) to the dead threads
+    and the budget completes."""
+    train, test = train_test_split(
+        rcv1_like(240, n_features=64, nnz=6, noise=0.0, seed=34))
+    eng = HogwildEngine(
+        LogisticRegression(lam=1e-5, n_features=64, regularizer="l2"),
+        n_workers=3, batch_size=8, learning_rate=0.02,
+        check_every=500, backoff_s=0.05,
+    )
+    max_epochs = 60
+    box = {}
+
+    def run():
+        try:
+            box["res"] = eng.fit(train, test, max_epochs=max_epochs,
+                                 stall_timeout_s=0.5, max_restarts=2)
+        except Exception as e:  # noqa: BLE001
+            box["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    _await(lambda: eng._updates > 50, msg="first updates")
+    for w in eng._workers:
+        w.stop_async()  # thread exits cleanly = "dead" to the watchdog
+    t.join(timeout=120)
+    assert not t.is_alive(), "hogwild fit did not terminate"
+    assert "exc" not in box, f"hogwild fit raised: {box.get('exc')}"
+    assert box["res"].state.updates >= len(train) * max_epochs
+
+
+def test_hogwild_stall_with_no_restarts_raises():
+    """max_restarts=0 and every worker dead: the watchdog must abort
+    cleanly (RuntimeError), never spin."""
+    train, test = train_test_split(
+        rcv1_like(240, n_features=64, nnz=6, noise=0.0, seed=35))
+    eng = HogwildEngine(
+        LogisticRegression(lam=1e-5, n_features=64, regularizer="l2"),
+        n_workers=2, batch_size=8, learning_rate=0.02,
+        check_every=10_000, backoff_s=0.05,
+    )
+    box = {}
+
+    def run():
+        try:
+            box["res"] = eng.fit(train, test, max_epochs=100_000,
+                                 stall_timeout_s=0.3, max_restarts=0)
+        except Exception as e:  # noqa: BLE001
+            box["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    _await(lambda: eng._updates > 0, msg="first updates")
+    for w in eng._workers:
+        w.stop_async()
+    t.join(timeout=60)
+    assert not t.is_alive(), "hogwild fit spun instead of aborting"
+    assert isinstance(box.get("exc"), RuntimeError)
+    assert "stalled" in str(box["exc"])
