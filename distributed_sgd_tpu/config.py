@@ -73,6 +73,10 @@ class Config:
     optimizer: str = "sgd"  # sgd (reference) | momentum | adam (sync engine)
     momentum: float = 0.9  # used by optimizer='momentum'
     steps_per_dispatch: int = 1  # async: k local steps per gossip dispatch
+    # tensor parallelism: shard the blocked weight rows over F feature
+    # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
+    # needs workers x F devices).  1 = the 1-D DP engines (default)
+    feature_shards: int = 1
 
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
@@ -100,6 +104,23 @@ class Config:
             raise ValueError("checkpoint_every must be >= 1")
         if self.steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        if self.feature_shards < 1:
+            raise ValueError("feature_shards must be >= 1")
+        if self.feature_shards > 1 and self.use_async:
+            raise ValueError(
+                "feature_shards is a sync (2-D mesh) engine; it cannot be "
+                "combined with use_async"
+            )
+        if self.feature_shards > 1 and self.engine == "rpc":
+            raise ValueError(
+                "feature_shards needs the mesh engine (2-D shard_map); the "
+                "rpc topology has no feature axis"
+            )
+        if self.feature_shards > 1 and self.optimizer != "sgd":
+            raise ValueError(
+                "the feature-sharded engine runs the reference's plain SGD "
+                "update; optimizer must be 'sgd' when feature_shards > 1"
+            )
         if self.exact_topology and self.virtual_workers != 1:
             raise ValueError(
                 "exact_topology and an explicit virtual_workers are mutually "
@@ -155,6 +176,7 @@ class Config:
             optimizer=_env("DSGD_OPTIMIZER", cls.optimizer, str),
             momentum=_env("DSGD_MOMENTUM", cls.momentum, float),
             steps_per_dispatch=_env("DSGD_STEPS_PER_DISPATCH", cls.steps_per_dispatch, int),
+            feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
         )
         return dataclasses.replace(cfg, **overrides)
 

@@ -151,6 +151,26 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     )
 
     ckpt = _make_checkpointer(cfg)
+    if cfg.feature_shards > 1:
+        # dp x tp: config.__post_init__ already rejected async/rpc combos
+        from distributed_sgd_tpu.parallel.feature_sharded import (
+            FeatureShardedEngine,
+            make_mesh_2d,
+        )
+
+        n_devs = len(jax.devices())
+        n_w = max(1, n_devs // cfg.feature_shards)
+        log.info("engine=mesh 2-D dp=%d x tp=%d (feature_shards)",
+                 n_w, cfg.feature_shards)
+        eng = FeatureShardedEngine(
+            model, make_mesh_2d(n_w, cfg.feature_shards),
+            batch_size=cfg.batch_size, learning_rate=cfg.learning_rate,
+        )
+        res = eng.fit(train, test, cfg.max_epochs, criterion,
+                      checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
+                      seed=cfg.seed)
+        _finish(cfg, res, saved=ckpt is not None)
+        return
     if cfg.use_async and cfg.async_mode == "gossip":
         from distributed_sgd_tpu.parallel.hogwild import HogwildEngine
 

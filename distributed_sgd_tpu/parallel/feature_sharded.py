@@ -36,6 +36,15 @@ Weight memory and the scatter/gather matmul FLOPs both scale 1/F per
 device — the pattern that matters when the feature dimension outgrows one
 chip, and a working demonstration that the framework's mesh design
 composes axes (dp x tp) rather than being hardwired to one.
+
+First-class engine surface (VERDICT r4 item 4): `fit` (epoch loop, early
+stopping, checkpoint/resume via the SHARED sync snapshot contract — a
+feature-sharded checkpoint resumes in the 1-D SyncTrainer and vice
+versa), `evaluate`/`predict` (TP-sharded eval: partial margins psum'd
+over 'features', loss/hit sums psum'd over 'workers' — the same chunked
+scan as parallel/sync.py _eval_shard), and a config/CLI surface
+(DSGD_FEATURE_SHARDS=F routes the dev-mode sync scenario here,
+config.py/main.py).
 """
 
 from __future__ import annotations
@@ -161,11 +170,98 @@ class FeatureShardedEngine:
             jnp.asarray(ds_full), NamedSharding(self.mesh, P(FEATURES, None))
         )
 
+    def _margins_local(self, w2_local, ci, cv):
+        """Per-sample margins on the 2-D mesh: local shifted one-hot gather
+        then the TP partial-sum over 'features' (same shift trick as _step)."""
+        offset = jax.lax.axis_index(FEATURES) * self.r_local * LANES
+        oh = mxu.OneHotBatch(SparseBatch(ci - offset, cv), self.r_local)
+        return jax.lax.psum(oh.margins(w2_local), FEATURES)
+
+    def _chunk_margins(self, w2_local, ci, cv):
+        """512-sample sub-scan bound on the one-hot working set (the same
+        bound parallel/sync.py _chunk_margins applies to the 1-D engine)."""
+        sub = 512
+        n = ci.shape[0]
+        if n <= sub or n % sub != 0:
+            return self._margins_local(w2_local, ci, cv)
+
+        def body(_, t):
+            cci = jax.lax.dynamic_slice_in_dim(ci, t * sub, sub, 0)
+            ccv = jax.lax.dynamic_slice_in_dim(cv, t * sub, sub, 0)
+            return (), self._margins_local(w2_local, cci, ccv)
+
+        _, m = jax.lax.scan(body, (), jnp.arange(n // sub))
+        return m.reshape(-1)
+
+    def _chunk_margins_dense(self, w2_local, cv):
+        """Dense column tiles: local [C, D/F] @ [D/F] matvec, psum'd."""
+        w_flat = w2_local.reshape(-1).astype(jnp.float32)
+        return jax.lax.psum(
+            jnp.dot(cv.astype(jnp.float32), w_flat,
+                    precision=jax.lax.Precision.HIGHEST),
+            FEATURES,
+        )
+
+    def _eval_shard(self, w2, *arrs):
+        """(loss_sum, hit_sum) over this worker shard's true rows (pads
+        carry label 0 and are masked) — parallel/sync.py _eval_shard with
+        the margins computed TP-sharded."""
+        chunk = self.eval_chunk
+        n_chunks = self.shard_n // chunk
+        if self.dense:
+            val, y = arrs
+        else:
+            idx, val, y = arrs
+
+        def body(acc, t):
+            loss_acc, hit_acc = acc
+            s = t * chunk
+            cv = jax.lax.dynamic_slice_in_dim(val, s, chunk, 0)
+            cy = jax.lax.dynamic_slice_in_dim(y, s, chunk, 0)
+            if self.dense:
+                margins = self._chunk_margins_dense(w2, cv)
+            else:
+                ci = jax.lax.dynamic_slice_in_dim(idx, s, chunk, 0)
+                margins = self._chunk_margins(w2, ci, cv)
+            mask = (cy != 0).astype(jnp.float32)
+            losses = self.model.losses_from_margins(margins, cy)
+            hits = (self.model.predict(margins) == cy.astype(jnp.float32))
+            return (loss_acc + jnp.sum(losses * mask),
+                    hit_acc + jnp.sum(hits.astype(jnp.float32) * mask)), ()
+
+        init = jax.lax.pcast(
+            (jnp.float32(0), jnp.float32(0)), (WORKERS,), to="varying")
+        (loss_sum, hit_sum), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+        return jax.lax.psum(jnp.stack([loss_sum, hit_sum]), WORKERS)
+
+    def _predict_shard(self, w2, *arrs):
+        chunk = self.eval_chunk
+        n_chunks = self.shard_n // chunk
+        if self.dense:
+            (val,) = arrs
+        else:
+            idx, val = arrs
+
+        def body(_, t):
+            s = t * chunk
+            cv = jax.lax.dynamic_slice_in_dim(val, s, chunk, 0)
+            if self.dense:
+                margins = self._chunk_margins_dense(w2, cv)
+            else:
+                ci = jax.lax.dynamic_slice_in_dim(idx, s, chunk, 0)
+                margins = self._chunk_margins(w2, ci, cv)
+            return (), self.model.predict(margins)
+
+        _, preds = jax.lax.scan(body, (), jnp.arange(n_chunks))
+        return preds.reshape(-1)
+
     def bind(self, data: Dataset):
         self.dense = data.is_dense
-        total, _chunk = padded_layout(len(data), self.n_workers, 4096)
+        self.n_true = len(data)
+        total, chunk = padded_layout(len(data), self.n_workers, 4096)
         padded = _pad_to_exact(data, total)
         self.shard_n = total // self.n_workers
+        self.eval_chunk = chunk
         self._ds = self._bind_ds()
         if self.dense:
             # column-pad the dense rows to the blocked row grid so the
@@ -217,6 +313,23 @@ class FeatureShardedEngine:
                 epoch_shard, mesh=self.mesh, in_specs=in_specs, out_specs=wspec
             )
         )
+        if self.dense:
+            eval_in = (wspec, P(WORKERS, FEATURES), P(WORKERS))
+            pred_in = (wspec, P(WORKERS, FEATURES))
+        else:
+            eval_in = (wspec, P(WORKERS, None), P(WORKERS, None), P(WORKERS))
+            pred_in = (wspec, P(WORKERS, None), P(WORKERS, None))
+        self._eval_sm = jax.jit(
+            jax.shard_map(
+                self._eval_shard, mesh=self.mesh, in_specs=eval_in, out_specs=P()
+            )
+        )
+        self._predict_sm = jax.jit(
+            jax.shard_map(
+                self._predict_shard, mesh=self.mesh, in_specs=pred_in,
+                out_specs=P(WORKERS),
+            )
+        )
         return self
 
     def init_weights(self) -> jax.Array:
@@ -233,3 +346,137 @@ class FeatureShardedEngine:
 
     def to_dense(self, w2: jax.Array) -> np.ndarray:
         return np.asarray(w2).reshape(-1)[: self.model.n_features]
+
+    def from_dense(self, w) -> jax.Array:
+        """Dense [n_features] weights -> blocked, feature-sharded [r_total,
+        128] (inverse of to_dense; the checkpoint/resume interchange path)."""
+        w2 = mxu.to_blocked_np(
+            np.asarray(w, dtype=np.float32), self.model.n_features)
+        full = np.zeros((self.r_total, LANES), np.float32)
+        full[: w2.shape[0]] = w2
+        return jax.device_put(
+            jnp.asarray(full), NamedSharding(self.mesh, P(FEATURES, None))
+        )
+
+    def predict(self, w2: jax.Array) -> np.ndarray:
+        """Predictions for every true sample of the bound split
+        (Master.predict fan-out equivalent, Master.scala:61-75)."""
+        arrs = (self._val,) if self.dense else (self._idx, self._val)
+        return np.asarray(self._predict_sm(w2, *arrs))[: self.n_true]
+
+    def evaluate(self, w2: jax.Array):
+        """(objective, accuracy) over the bound split — same contract as
+        BoundSync.evaluate (objective = lam*||w||^2 + mean sample loss,
+        SparseSVM.scala:20-23)."""
+        arrs = ((self._val, self._y) if self.dense
+                else (self._idx, self._val, self._y))
+        sums = self._eval_sm(w2, *arrs)
+        loss_sum, hit_sum = float(sums[0]), float(sums[1])
+        w = self.to_dense(w2)
+        reg = self.model.lam * float(np.dot(w, w))
+        return reg + loss_sum / self.n_true, hit_sum / self.n_true
+
+    def fit(
+        self,
+        train: Dataset,
+        test: Dataset,
+        max_epochs: int,
+        criterion=None,
+        initial_weights=None,
+        checkpointer=None,
+        checkpoint_every: int = 1,
+        seed: int = 0,
+    ):
+        """Epoch loop + early stopping + checkpoint/resume, the SyncTrainer
+        fit contract (core/trainer.py) on the 2-D mesh.
+
+        Checkpoints use the SHARED sync snapshot contract (dense weights +
+        newest-first test-loss history, checkpoint.sync_fit_extra with the
+        plain-SGD kind): a feature-sharded snapshot resumes in the 1-D
+        SyncTrainer / RPC fit_sync and vice versa.
+
+        Known debt: this mirrors SyncTrainer.fit's loop protocol (cadence
+        save, off-cadence final save, newest-first criterion history)
+        rather than sharing code — the trainer is coupled to the 1-D
+        engine's bind/opt-state surface.  The interchange contract that
+        matters is pinned by tests/test_feature_sharded.py::
+        test_fit_checkpoint_interchanges_with_sync_trainer, which fails if
+        either copy drifts.
+        """
+        import time
+
+        from distributed_sgd_tpu.core.grad_state import GradState
+        from distributed_sgd_tpu.core.trainer import FitResult, log as tlog
+
+        self.bind(train)
+        test_bound = FeatureShardedEngine(
+            self.model, self.mesh, self.batch_size, self.learning_rate
+        ).bind(test)
+        w2 = (self.init_weights() if initial_weights is None
+              else self.from_dense(initial_weights))
+        base_key = jax.random.PRNGKey(seed)
+        result = FitResult(state=GradState(weights=jnp.asarray(self.to_dense(w2))))
+        test_newest_first = []
+
+        from distributed_sgd_tpu.checkpoint import (
+            decode_sync_fit_state,
+            sync_fit_extra,
+        )
+
+        start_epoch = 0
+        if checkpointer is not None:
+            restored = checkpointer.restore_latest()
+            if restored is not None:
+                start_epoch, state = restored
+                w2 = self.from_dense(np.asarray(state["weights"]))
+                test_newest_first, _ = decode_sync_fit_state(state, "sgd", [])
+                tlog.info("resumed feature-sharded fit from checkpoint at "
+                          "epoch %d", start_epoch)
+
+        if start_epoch >= max_epochs:
+            loss, acc = self.evaluate(w2)
+            result.epochs_run = start_epoch
+            result.state = GradState(
+                weights=jnp.asarray(self.to_dense(w2)), loss=loss).finish()
+            return result
+
+        for epoch in range(start_epoch, max_epochs):
+            t0 = time.perf_counter()
+            w2 = self.epoch(w2, jax.random.fold_in(base_key, epoch))
+            jax.block_until_ready(w2)
+            epoch_s = time.perf_counter() - t0
+            loss, acc = self.evaluate(w2)
+            test_loss, test_acc = test_bound.evaluate(w2)
+            result.losses.append(loss)
+            result.accuracies.append(acc)
+            result.test_losses.append(test_loss)
+            result.test_accuracies.append(test_acc)
+            result.epoch_seconds.append(epoch_s)
+            result.epochs_run = epoch + 1
+            test_newest_first.insert(0, test_loss)
+            tlog.info(
+                "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f "
+                "(%.2fs, %d feature shards)",
+                epoch, loss, acc, test_loss, test_acc, epoch_s, self.n_shards,
+            )
+            if checkpointer is not None and (epoch + 1) % checkpoint_every == 0:
+                checkpointer.save(
+                    epoch + 1, jnp.asarray(self.to_dense(w2)),
+                    extra=sync_fit_extra(test_newest_first, "sgd", []))
+            if criterion is not None and criterion(test_newest_first):
+                tlog.info("Converged to target: stopping computation")
+                break
+        if (
+            checkpointer is not None
+            and result.epochs_run > start_epoch
+            and result.epochs_run % checkpoint_every != 0
+        ):
+            checkpointer.save(
+                result.epochs_run, jnp.asarray(self.to_dense(w2)),
+                extra=sync_fit_extra(test_newest_first, "sgd", []))
+
+        result.state = GradState(
+            weights=jnp.asarray(self.to_dense(w2)),
+            loss=result.losses[-1] if result.losses else float("nan"),
+        ).finish()
+        return result

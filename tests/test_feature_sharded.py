@@ -117,3 +117,137 @@ def test_dense_layout_matches_dp_engine_trajectory(regularizer):
 
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
     assert np.any(got != 0.0)
+
+
+# -- first-class engine surface (VERDICT r4 item 4) -------------------------
+
+
+def test_evaluate_and_predict_match_dp_engine():
+    """TP-sharded evaluate/predict must agree with the 1-D engine's on the
+    SAME weights: partial margins psum'd over 'features' reproduce the full
+    gather exactly."""
+    d = 700
+    data, model = _setup(d)
+    tp = FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=4,
+                              learning_rate=0.3).bind(data)
+    dp = SyncEngine(model, make_mesh(2), batch_size=4, learning_rate=0.3).bind(data)
+
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=d).astype(np.float32) * 0.1
+    loss_tp, acc_tp = tp.evaluate(tp.from_dense(w))
+    loss_dp, acc_dp = dp.evaluate(jnp.asarray(w))
+    assert loss_tp == pytest.approx(loss_dp, rel=1e-5)
+    assert acc_tp == pytest.approx(acc_dp, abs=1e-9)
+    np.testing.assert_array_equal(
+        tp.predict(tp.from_dense(w)), dp.predict(jnp.asarray(w)))
+
+
+def test_evaluate_and_predict_match_dp_engine_dense_layout():
+    from distributed_sgd_tpu.data.rcv1 import Dataset
+
+    d, n = 300, 64
+    rng = np.random.default_rng(13)
+    vals = (rng.random((n, d)) * (rng.random((n, d)) < 0.3)).astype(np.float32)
+    labels = np.where(rng.random(n) < 0.5, 1, -1).astype(np.int32)
+    data = Dataset.dense(vals, labels)
+    model = SparseSVM(lam=1e-3, n_features=d, regularizer="l2")
+    tp = FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=4,
+                              learning_rate=0.3).bind(data)
+    dp = SyncEngine(model, make_mesh(2), batch_size=4, learning_rate=0.3).bind(data)
+    w = rng.normal(size=d).astype(np.float32) * 0.1
+    loss_tp, acc_tp = tp.evaluate(tp.from_dense(w))
+    loss_dp, acc_dp = dp.evaluate(jnp.asarray(w))
+    assert loss_tp == pytest.approx(loss_dp, rel=1e-5)
+    assert acc_tp == pytest.approx(acc_dp, abs=1e-9)
+    np.testing.assert_array_equal(
+        tp.predict(tp.from_dense(w)), dp.predict(jnp.asarray(w)))
+
+
+def test_from_dense_roundtrip():
+    d = 700
+    _, model = _setup(d)
+    eng = FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=4,
+                               learning_rate=0.3)
+    w = np.random.default_rng(5).normal(size=d).astype(np.float32)
+    np.testing.assert_array_equal(eng.to_dense(eng.from_dense(w)), w)
+
+
+def test_fit_converges_and_early_stops():
+    from distributed_sgd_tpu.core.early_stopping import no_improvement
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+    d = 256
+    train, test = train_test_split(
+        rcv1_like(160, n_features=d, nnz=8, noise=0.0, seed=9))
+    model = SparseSVM(lam=1e-4, n_features=d, regularizer="l2")
+    eng = FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=8,
+                               learning_rate=0.3)
+    res = eng.fit(train, test, max_epochs=30,
+                  criterion=no_improvement(patience=3, min_delta=0.001))
+    assert res.epochs_run >= 1
+    assert res.losses[-1] < res.losses[0]
+    assert len(res.test_losses) == res.epochs_run
+    assert np.any(np.asarray(res.state.weights) != 0.0)
+
+
+def test_fit_checkpoint_interchanges_with_sync_trainer(tmp_path):
+    """The shared sync snapshot contract: a feature-sharded checkpoint
+    resumes in the 1-D SyncTrainer (and the resumed criterion sees the
+    same newest-first test-loss history)."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.core.trainer import SyncTrainer
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+
+    d = 256
+    train, test = train_test_split(
+        rcv1_like(160, n_features=d, nnz=8, noise=0.0, seed=10))
+    model = SparseSVM(lam=1e-4, n_features=d, regularizer="l2")
+    eng = FeatureShardedEngine(model, make_mesh_2d(2, 4), batch_size=8,
+                               learning_rate=0.3)
+    res1 = eng.fit(train, test, max_epochs=2,
+                   checkpointer=Checkpointer(str(tmp_path)))
+    assert res1.epochs_run == 2
+    # resume the SAME snapshot in the 1-D trainer for 2 more epochs
+    trainer = SyncTrainer(model, make_mesh(2), batch_size=8, learning_rate=0.3,
+                          checkpointer=Checkpointer(str(tmp_path)))
+    res2 = trainer.fit(train, test, max_epochs=4)
+    assert res2.epochs_run == 4
+    assert len(res2.test_losses) == 2  # only epochs 2..3 ran here
+    # and the feature-sharded fit resumes its own (now epoch-4) snapshot:
+    # nothing left to run below max_epochs=4
+    res3 = eng.fit(train, test, max_epochs=4,
+                   checkpointer=Checkpointer(str(tmp_path)))
+    assert res3.epochs_run == 4 and len(res3.test_losses) == 0
+
+
+def test_config_routes_feature_shards():
+    from distributed_sgd_tpu.config import Config
+
+    cfg = Config(feature_shards=2)
+    assert cfg.feature_shards == 2
+    with pytest.raises(ValueError):
+        Config(feature_shards=2, use_async=True)
+    with pytest.raises(ValueError):
+        Config(feature_shards=2, engine="rpc")
+    with pytest.raises(ValueError):
+        Config(feature_shards=2, optimizer="adam")
+    with pytest.raises(ValueError):
+        Config(feature_shards=0)
+
+
+def test_scenario_mesh_runs_feature_sharded(monkeypatch, tmp_path):
+    """DSGD_FEATURE_SHARDS routing: the dev-mode sync scenario runs the
+    2-D engine end to end (fit + final eval + checkpoint)."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.config import Config
+    from distributed_sgd_tpu.main import build, scenario_mesh
+
+    monkeypatch.setenv("DSGD_SYNTHETIC", "160")
+    cfg = Config(feature_shards=4, node_count=2, batch_size=8,
+                 max_epochs=2, checkpoint_dir=str(tmp_path),
+                 model="logistic", learning_rate=0.1)
+    train, test, model = build(cfg)
+    scenario_mesh(cfg, train, test, model)  # must not raise
+
+    restored = Checkpointer(str(tmp_path)).restore_latest()
+    assert restored is not None and restored[0] == 2
