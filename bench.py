@@ -90,9 +90,10 @@ def gen_data(n: int, seed: int = 0):
     return idx, val, y
 
 
-def tpu_epoch_seconds(idx, val, y) -> tuple:
-    """Slope-fit sync epoch wall-clock on the TPU (3-worker topology)."""
-    import jax
+def _bind_flagship(idx, val, y, batch_size: int):
+    """Flagship model + 3-worker sync engine bound to the full dataset —
+    the ONE binding both operating points (B=100 parity, B=1024
+    unconstrained) measure, so their methodology cannot diverge."""
     import jax.numpy as jnp
 
     from distributed_sgd_tpu.data.rcv1 import Dataset
@@ -104,15 +105,23 @@ def tpu_epoch_seconds(idx, val, y) -> tuple:
     ds = np.zeros(N_FEATURES, dtype=np.float32)
     nz = counts > 0
     ds[nz] = 1.0 / (counts[nz] + 1.0)
-
     model = SparseSVM(lam=LAM, n_features=N_FEATURES, dim_sparsity=jnp.asarray(ds))
     mesh = make_mesh(1)  # one real chip; same code scales over the mesh
     engine = SyncEngine(
-        model, mesh, batch_size=BATCH, learning_rate=LR, virtual_workers=N_WORKERS
+        model, mesh, batch_size=batch_size, learning_rate=LR,
+        virtual_workers=N_WORKERS,
     )
-    bound = engine.bind(Dataset(indices=idx, values=val, labels=y, n_features=N_FEATURES))
-    log(f"steps per epoch: {bound.steps_per_epoch} "
-        f"(= ceil(ceil({N_SAMPLES}/{N_WORKERS})/{BATCH}))")
+    return engine.bind(
+        Dataset(indices=idx, values=val, labels=y, n_features=N_FEATURES))
+
+
+def _slope_epoch_seconds(bound, label: str = "") -> tuple:
+    """Slope-fit epoch wall-clock: best-of-5 single-dispatch multi-epoch
+    runs at 1 and 3 epochs, epoch_s = (t3 - t1) / 2 — excludes the
+    tunnel's ~100 ms per-dispatch transport — plus a 3-epoch convergence
+    sanity eval outside the timed region."""
+    import jax
+    import jax.numpy as jnp
 
     w0 = jnp.zeros((N_FEATURES,), dtype=jnp.float32)
     key = jax.random.PRNGKey(0)
@@ -122,7 +131,8 @@ def tpu_epoch_seconds(idx, val, y) -> tuple:
     for n_ep in (1, 3):
         t0 = time.perf_counter()
         np.asarray(bound.multi_epoch(w0, key, n_ep))  # compile + warm (pull)
-        log(f"compile+first run ({n_ep} epochs): {time.perf_counter() - t0:.1f}s")
+        log(f"{label}compile+first run ({n_ep} epochs): "
+            f"{time.perf_counter() - t0:.1f}s")
         # best-of-5: the shared-TPU tunnel has high run-to-run variance
         best = float("inf")
         for _rep in range(5):
@@ -130,14 +140,59 @@ def tpu_epoch_seconds(idx, val, y) -> tuple:
             np.asarray(bound.multi_epoch(w0, key, n_ep))
             best = min(best, time.perf_counter() - t0)
         times[n_ep] = best
-        log(f"best timed run ({n_ep} epochs): {best:.3f}s")
+        log(f"{label}best timed run ({n_ep} epochs): {best:.3f}s")
     epoch_s = (times[3] - times[1]) / 2.0
 
-    # convergence sanity on real weights (outside the timed region)
     w = bound.multi_epoch(w0, key, 3)
     loss, acc = bound.evaluate(w)
-    log(f"epoch={epoch_s:.4f}s; after 3 epochs: loss={loss:.4f} acc={acc:.4f}")
-    return epoch_s, loss, acc
+    log(f"{label}epoch={epoch_s:.4f}s; after 3 epochs: "
+        f"loss={loss:.4f} acc={acc:.4f}")
+    return epoch_s, float(loss), float(acc)
+
+
+def tpu_epoch_seconds(idx, val, y) -> tuple:
+    """Slope-fit sync epoch wall-clock on the TPU (3-worker topology)."""
+    bound = _bind_flagship(idx, val, y, BATCH)
+    log(f"steps per epoch: {bound.steps_per_epoch} "
+        f"(= ceil(ceil({len(y)}/{N_WORKERS})/{BATCH}))")
+    return _slope_epoch_seconds(bound)
+
+
+B_UNCONSTRAINED = 1024  # best measured throughput config (BASELINE.md sweep)
+
+
+def tpu_b1024_throughput(idx, val, y) -> dict:
+    """Unconstrained operating point (VERDICT r4 item 5): the SAME epoch
+    (same data, model, 3-worker topology, reference lr=0.5) at the
+    framework's best per-dispatch batch, B=1024 — the 2.4x throughput
+    lever the sweep table quantified (BASELINE.md: B=100->1024 at K=3 runs
+    10.24x the work per step in 4.3x the time).  Batch size is a
+    CONVERGENCE hyperparameter pinned at 100 by reference parity, so this
+    is a documented superset config, benched end to end with the SAME
+    binding + slope-fit helpers as the headline: epoch seconds and
+    achieved TFLOP/s with the FLOP numerator from XLA's own cost model
+    (compiled.cost_analysis(), which counts the lax.scan body once =
+    per-step flops; no hand constants).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    bound = _bind_flagship(idx, val, y, B_UNCONSTRAINED)
+    steps = bound.steps_per_epoch
+    epoch_s, loss, acc = _slope_epoch_seconds(bound, label="b1024 ")
+
+    w0 = jnp.zeros((N_FEATURES,), dtype=jnp.float32)
+    key = jax.random.PRNGKey(0)
+    compiled = bound._epoch.lower(
+        w0, bound._opt_state, bound.data.indices, bound.data.values,
+        bound.data.labels, key,
+    ).compile()
+    flops_step = float((compiled.cost_analysis() or {}).get("flops", 0.0))
+    tflops_per_s = flops_step * steps / epoch_s / 1e12 if epoch_s > 0 else 0.0
+    log(f"b1024: {flops_step * steps / 1e12:.2f} TF/epoch over {steps} steps "
+        f"-> {tflops_per_s:.1f} TF/s")
+    return {"epoch_s": epoch_s, "steps": steps, "tflops_per_s": tflops_per_s,
+            "loss3": loss, "acc3": acc}
 
 
 def _expected_w_nnz(batches_done: int) -> float:
@@ -253,6 +308,7 @@ def main() -> None:
     floor = boxed_floor_epoch_seconds(idx, val, y)
     model = baseline_epoch_seconds(idx, val, y)
     epoch_s, loss, acc = tpu_epoch_seconds(idx, val, y)
+    b1024 = tpu_b1024_throughput(idx, val, y)
 
     # JVM-model views (all labeled as modeled): wire-speed sensitivity
     # range + a ratio with the modeled wire term dropped entirely
@@ -276,26 +332,49 @@ def main() -> None:
         "jvm_model_breakdown_s": {k2: round(v, 2) for k2, v in model.items()},
         "final_loss": round(float(loss), 4),
         "final_acc": round(float(acc), 4),
+        # unconstrained operating point (B=1024 superset config, same lr):
+        # _seconds/_per_s suffixes gate these against their own history
+        "b1024_epoch_seconds": round(b1024["epoch_s"], 4),
+        "b1024_tflops_per_s": round(b1024["tflops_per_s"], 2),
+        "b1024_vs_b100_epoch_speedup": round(epoch_s / b1024["epoch_s"], 2)
+        if b1024["epoch_s"] > 0 else 0.0,
+        "b1024_loss3_info": round(b1024["loss3"], 4),
         "n_samples": N_SAMPLES,
         "n_features": N_FEATURES,
         "batch_size": BATCH,
         "n_workers": N_WORKERS,
         "steps_per_epoch": STEPS_PER_EPOCH,
     }
-    print(json.dumps(result))
-
     # round-over-round regression gate (benches/regress.py, the ScalaMeter
-    # RegressionReporter equivalent): compare against stored history with
-    # shared-chip-variance tolerance, then append this run.  Verdict goes
-    # to stderr; the stdout contract stays ONE JSON line, and a regression
-    # never fails the bench itself (the gate command does that:
-    # `python bench.py | python benches/regress.py gate`).
+    # RegressionReporter equivalent): compare against stored history BEFORE
+    # printing, so the stdout JSON line itself carries the verdict in a
+    # "regressed" field the driver's BENCH_r record preserves.  A clean run
+    # is appended to history; a REGRESSED run is NOT (recording it would
+    # drag the rolling median toward the regression — same policy as the
+    # kernel gate in sparse_bench.py).  Per-metric detail goes to stderr;
+    # the stdout contract stays ONE JSON line.
     try:
         from benches import regress
 
-        regress.gate(result)
+        regressions, lines = regress.check(result, regress.load_history())
+        result["regressed"] = regressions
+        log(f"regression gate vs stored history, tolerance "
+            f"{regress.DEFAULT_TOLERANCE:.0%}:")
+        for ln in lines:
+            log(ln)
+        if regressions:
+            log(f"FAIL: regressed metrics: {', '.join(regressions)} "
+                f"(run NOT recorded)")
+        else:
+            regress.record(result)
+            log("PASS: run appended to benches/history.json")
     except Exception as e:  # noqa: BLE001 - gating must not break the bench
         log(f"regression gate skipped: {e}")
+        # null, NOT []: "the gate could not run" must stay distinguishable
+        # from "the gate ran and found nothing" in the driver's record
+        result["regressed"] = None
+        result["gate_error"] = str(e)
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
