@@ -149,6 +149,53 @@ def test_hogwild_all_workers_stopped_watchdog_restarts_and_completes():
     assert box["res"].state.updates >= len(train) * max_epochs
 
 
+def test_hogwild_crashed_step_restarts_and_completes():
+    """A worker whose compiled step RAISES (true crash, not a clean stop)
+    kills its loop thread; the watchdog must re-issue StartAsync and the
+    budget must still complete.  The injected fault clears after one
+    raise, so the restarted loop trains normally."""
+    train, test = train_test_split(
+        rcv1_like(240, n_features=64, nnz=6, noise=0.0, seed=36))
+    eng = HogwildEngine(
+        LogisticRegression(lam=1e-5, n_features=64, regularizer="l2"),
+        n_workers=3, batch_size=8, learning_rate=0.02,
+        check_every=500, backoff_s=0.05,
+    )
+    max_epochs = 40
+    box = {}
+
+    def run():
+        try:
+            box["res"] = eng.fit(train, test, max_epochs=max_epochs,
+                                 stall_timeout_s=0.5, max_restarts=2)
+        except Exception as e:  # noqa: BLE001
+            box["exc"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    _await(lambda: eng._updates > 50, msg="first updates")
+    victim = eng._workers[0]
+    crashed = {"n": 0}
+    orig_step = victim._step
+
+    def flaky(*args, **kwargs):
+        if crashed["n"] == 0:
+            crashed["n"] += 1
+            raise RuntimeError("injected kernel crash")
+        return orig_step(*args, **kwargs)
+
+    victim._step = flaky
+    # stop the OTHER workers so the budget can only complete if the
+    # crashed victim actually gets restarted
+    for w in eng._workers[1:]:
+        w.stop_async()
+    t.join(timeout=120)
+    assert not t.is_alive(), "hogwild fit did not terminate"
+    assert "exc" not in box, f"hogwild fit raised: {box.get('exc')}"
+    assert crashed["n"] == 1, "the injected crash never fired"
+    assert box["res"].state.updates >= len(train) * max_epochs
+
+
 def test_hogwild_stall_with_no_restarts_raises():
     """max_restarts=0 and every worker dead: the watchdog must abort
     cleanly (RuntimeError), never spin."""
