@@ -30,3 +30,10 @@ def test_dense_example():
 
     mse = main(n=800, d=32, epochs=2)
     assert np.isfinite(mse) and mse < 1.0
+
+
+def test_feature_sharded_example():
+    from examples.train_feature_sharded import main
+
+    loss = main(n=800, max_epochs=2)
+    assert np.isfinite(loss)
