@@ -9,7 +9,9 @@ host/port the process is the master; otherwise it is a worker.
 
 Capability supersets over the reference (documented, opt-in):
 ``model`` (hinge | logistic | least_squares), ``checkpoint_dir`` (orbax),
-``async_mode`` (gossip | local_sgd), ``sync_period`` for on-mesh local-SGD.
+``async_mode`` (gossip | local_sgd), ``sync_period`` for on-mesh
+local-SGD, ``feature_shards`` for dp x tp tensor parallelism over a 2-D
+mesh (parallel/feature_sharded.py).
 """
 
 from __future__ import annotations
