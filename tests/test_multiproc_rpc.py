@@ -117,3 +117,97 @@ def test_three_process_fit(mode, tmp_path):
                     p.wait(timeout=max(0.1, deadline - time.time()))
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+@pytest.mark.slow
+def test_async_fit_survives_sigkilled_worker_process(tmp_path):
+    """The gold-standard async fault proof: a REAL worker process is
+    SIGKILLed mid-fit (no unregister, no TCP FIN courtesy — the OS just
+    reaps it).  The master's heartbeat declares it dead, the async fit's
+    membership check re-issues its sample assignment to the survivor, and
+    the lifetime budget completes — where the reference's MasterAsync
+    would count updates forever (MasterAsync.scala:164-177)."""
+    import threading
+
+    extra = {
+        "DSGD_ASYNC": "1",
+        "DSGD_CHECK_EVERY": "50",
+        "DSGD_CONV_DELTA": "0",
+        "DSGD_HEARTBEAT_S": "0.2",
+        # budget large enough that the kill lands mid-fit: 240 train rows
+        # x 120 epochs = 28,800 local steps; the "updates received"
+        # progress line fires at each 1000-update crossing
+        "DSGD_MAX_EPOCHS": "120",
+        "DSGD_STEPS_PER_DISPATCH": "16",
+        "DSGD_PATIENCE": "50",  # no early stop: run to the step budget
+    }
+    master_port, *worker_ports = _free_ports(3)
+    cmd = [sys.executable, "-m", "distributed_sgd_tpu.main"]
+    procs = []
+    worker_logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    lines: list = []
+    try:
+        with contextlib.ExitStack() as stack:
+            master = subprocess.Popen(
+                cmd, env=_env(master_port, master_port, extra),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            procs.append(master)
+            for port, logf in zip(worker_ports, worker_logs):
+                w = subprocess.Popen(
+                    cmd, env=_env(port, master_port, extra),
+                    stdout=stack.enter_context(open(logf, "w")),
+                    stderr=subprocess.STDOUT,
+                )
+                procs.append(w)
+
+            def pump():
+                for ln in master.stdout:
+                    lines.append(ln)
+
+            reader = threading.Thread(target=pump, daemon=True)
+            reader.start()
+
+            def saw(needle):
+                return any(needle in ln for ln in lines)
+
+            def diag():
+                tails = "\n".join(
+                    f"== {f.name}:\n{f.read_text()[-1200:]}" for f in worker_logs
+                    if f.exists())
+                return f"{''.join(lines)[-3000:]}\n{tails}"
+
+            deadline = time.time() + 240
+            while time.time() < deadline and not saw("updates received"):
+                if master.poll() is not None:
+                    raise AssertionError(f"master exited early:\n{diag()}")
+                time.sleep(0.2)
+            assert saw("updates received"), f"fit never progressed:\n{diag()}"
+
+            procs[1].send_signal(signal.SIGKILL)  # hard-kill worker 0
+
+            try:
+                master.wait(timeout=240)
+            except subprocess.TimeoutExpired:
+                master.kill()
+                raise AssertionError(
+                    f"master spun after the worker kill:\n{diag()}")
+            reader.join(timeout=10)
+            out = "".join(lines)
+            assert master.returncode == 0, diag()
+            # the dead worker was discovered and its samples re-issued
+            assert ("declared dead" in out or "unresponsive" in out), diag()
+            assert "re-issuing" in out or "reassigning" in out, diag()
+            # and the fit completed its budget (or converged) on the survivor
+            assert "fit done:" in out, diag()
+    finally:
+        deadline = time.time() + 10
+        for p in procs[1:]:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
