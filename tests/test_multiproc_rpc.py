@@ -135,9 +135,9 @@ def test_async_fit_survives_sigkilled_worker_process(tmp_path):
         "DSGD_CONV_DELTA": "0",
         "DSGD_HEARTBEAT_S": "0.2",
         # budget large enough that the kill lands mid-fit: 240 train rows
-        # x 120 epochs = 28,800 local steps; the "updates received"
+        # x 60 epochs = 14,400 local steps; the "updates received"
         # progress line fires at each 1000-update crossing
-        "DSGD_MAX_EPOCHS": "120",
+        "DSGD_MAX_EPOCHS": "60",
         "DSGD_STEPS_PER_DISPATCH": "16",
         "DSGD_PATIENCE": "50",  # no early stop: run to the step budget
     }
