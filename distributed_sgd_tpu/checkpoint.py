@@ -144,3 +144,55 @@ def decode_sync_fit_state(state: Dict[str, Any], opt_kind: str, expected_leaves)
             f"checkpoint_dir"
         )
     return test_nf, opt_leaves
+
+
+# -- the sync-fit snapshot PROTOCOL, single-sourced --------------------------
+# Three fit loops speak it (mesh SyncTrainer, RPC fit_sync, the 2-D
+# FeatureShardedEngine), and their checkpoints interchange BECAUSE they all
+# go through these helpers: weights + newest-first test-loss history (the
+# early-stopping window) + optimizer kind/leaves, saved every
+# `checkpoint_every` epochs plus once at any off-cadence end.
+
+
+def restore_sync_fit(checkpointer, opt_kind: str, expected_leaves):
+    """Restore the latest sync-fit snapshot, validated against the
+    configured optimizer.  Returns (start_epoch, weights_np,
+    test_losses_newest_first, opt_leaves), or None when there is no
+    checkpointer or no snapshot."""
+    if checkpointer is None:
+        return None
+    restored = checkpointer.restore_latest()
+    if restored is None:
+        return None
+    start_epoch, state = restored
+    test_nf, opt_leaves = decode_sync_fit_state(state, opt_kind, expected_leaves)
+    return start_epoch, np.asarray(state["weights"]), test_nf, opt_leaves
+
+
+def save_sync_fit(checkpointer, epoch: int, weights, test_losses_newest_first,
+                  opt_kind: str = "sgd", opt_leaves=()) -> None:
+    checkpointer.save(epoch, weights, extra=sync_fit_extra(
+        test_losses_newest_first, opt_kind, list(opt_leaves)))
+
+
+def save_sync_fit_final(checkpointer, epochs_run: int, start_epoch: int,
+                        checkpoint_every: int, weights,
+                        test_losses_newest_first, opt_kind: str = "sgd",
+                        opt_leaves=()) -> None:
+    """Off-cadence end (early stop, or max_epochs not a multiple of
+    `checkpoint_every`): persist the final state so no run with a
+    checkpointer ends unsaved.
+
+    `weights` may be a zero-arg callable, resolved only when the save
+    actually happens — so a caller whose weight materialization is
+    expensive (the feature-sharded engine's device->host gather) pays
+    nothing on the no-save path."""
+    if (
+        checkpointer is not None
+        and epochs_run > start_epoch
+        and epochs_run % checkpoint_every != 0
+    ):
+        if callable(weights):
+            weights = weights()
+        save_sync_fit(checkpointer, epochs_run, weights,
+                      test_losses_newest_first, opt_kind, opt_leaves)

@@ -28,11 +28,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_sgd_tpu.checkpoint import (
+    restore_sync_fit,
+    save_sync_fit,
+    save_sync_fit_final,
+)
 from distributed_sgd_tpu.core.early_stopping import Criterion
 from distributed_sgd_tpu.core.grad_state import GradState
 from distributed_sgd_tpu.core.loss_check import LossChecker, async_fit_result
 from distributed_sgd_tpu.core.split import vanilla_split
-from distributed_sgd_tpu.core.trainer import FitResult
+from distributed_sgd_tpu.core.trainer import FitResult, record_epoch
 from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
 from distributed_sgd_tpu.parallel.mesh import make_mesh
@@ -435,25 +440,17 @@ class MasterNode:
                 return optax.apply_updates(w_, updates), opt_state_
 
         start_epoch = 0
-        if checkpointer is not None:
-            restored = checkpointer.restore_latest()
-            if restored is not None:
-                from distributed_sgd_tpu.checkpoint import decode_sync_fit_state
-
-                start_epoch, state = restored
-                w = np.asarray(state["weights"], dtype=np.float32)
-                expected = (
-                    jax.tree_util.tree_leaves(opt_state) if opt is not None else []
+        expected = jax.tree_util.tree_leaves(opt_state) if opt is not None else []
+        restored = restore_sync_fit(checkpointer, opt_kind, expected)
+        if restored is not None:
+            start_epoch, w_np, test_newest_first, opt_leaves = restored
+            w = np.asarray(w_np, dtype=np.float32)
+            if opt is not None and opt_leaves:
+                opt_state = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(opt_state),
+                    [jnp.asarray(x) for x in opt_leaves],
                 )
-                test_newest_first, opt_leaves = decode_sync_fit_state(
-                    state, opt_kind, expected
-                )
-                if opt is not None and opt_leaves:
-                    opt_state = jax.tree_util.tree_unflatten(
-                        jax.tree_util.tree_structure(opt_state),
-                        [jnp.asarray(x) for x in opt_leaves],
-                    )
-                self.log.info("resumed sync fit from checkpoint at epoch %d", start_epoch)
+            self.log.info("resumed sync fit from checkpoint at epoch %d", start_epoch)
 
         if start_epoch >= max_epochs:
             loss, acc = self.local_loss(w)
@@ -536,13 +533,8 @@ class MasterNode:
 
             loss, acc = self.local_loss(w)
             test_loss, test_acc = self.local_loss(w, test=True)
-            result.losses.append(loss)
-            result.accuracies.append(acc)
-            result.test_losses.append(test_loss)
-            result.test_accuracies.append(test_acc)
-            result.epoch_seconds.append(epoch_s)
-            result.epochs_run = epoch + 1
-            test_newest_first.insert(0, test_loss)
+            record_epoch(result, test_newest_first, epoch,
+                         loss, acc, test_loss, test_acc, epoch_s)
             self.metrics.histogram("master.sync.loss").record(loss)
             self.metrics.histogram("master.sync.acc").record(100 * acc)
             self.metrics.histogram("master.sync.epoch.seconds").record(epoch_s)
@@ -551,34 +543,23 @@ class MasterNode:
                 epoch, loss, acc, test_loss, test_acc, epoch_s,
             )
             if checkpointer is not None and (epoch + 1) % checkpoint_every == 0:
-                checkpointer.save(epoch + 1, w, extra=self._sync_ckpt_extra(
-                    test_newest_first, opt_kind, opt_state))
+                save_sync_fit(
+                    checkpointer, epoch + 1, w, test_newest_first, opt_kind,
+                    jax.tree_util.tree_leaves(opt_state)
+                    if opt_state is not None else [])
             if criterion is not None and criterion(test_newest_first):
                 self.log.info("Converged to target: stopping computation")
                 break
 
-        # off-cadence end (early stop, or max_epochs % checkpoint_every != 0):
-        # persist the final state so no run with a checkpointer ends unsaved
-        if (
-            checkpointer is not None
-            and result.epochs_run > start_epoch
-            and result.epochs_run % checkpoint_every != 0
-        ):
-            checkpointer.save(result.epochs_run, w, extra=self._sync_ckpt_extra(
-                test_newest_first, opt_kind, opt_state))
+        save_sync_fit_final(
+            checkpointer, result.epochs_run, start_epoch, checkpoint_every,
+            w, test_newest_first, opt_kind,
+            jax.tree_util.tree_leaves(opt_state) if opt_state is not None else [])
 
         result.state = GradState(
             weights=w, loss=result.losses[-1] if result.losses else float("nan")
         ).finish()
         return result
-
-    def _sync_ckpt_extra(self, test_newest_first, opt_kind: str, opt_state):
-        """Shared snapshot contract (checkpoint.sync_fit_extra): mesh and
-        RPC sync checkpoints stay interchangeable."""
-        from distributed_sgd_tpu.checkpoint import sync_fit_extra
-
-        leaves = jax.tree_util.tree_leaves(opt_state) if opt_state is not None else []
-        return sync_fit_extra(test_newest_first, opt_kind, leaves)
 
     # -- async fit (MasterAsync.scala:32-162) ------------------------------
 

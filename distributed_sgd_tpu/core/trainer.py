@@ -20,6 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from distributed_sgd_tpu.checkpoint import (
+    restore_sync_fit,
+    save_sync_fit,
+    save_sync_fit_final,
+)
 from distributed_sgd_tpu.core.early_stopping import Criterion
 from distributed_sgd_tpu.core.grad_state import GradState
 from distributed_sgd_tpu.data.rcv1 import Dataset
@@ -43,6 +48,23 @@ class FitResult:
     @property
     def weights(self):
         return self.state.weights
+
+
+def record_epoch(result: FitResult, test_newest_first: List[float], epoch: int,
+                 loss: float, acc: float, test_loss: float, test_acc: float,
+                 epoch_s: float) -> None:
+    """Epoch-end bookkeeping shared by every sync fit loop (mesh trainer,
+    RPC fit_sync, feature-sharded fit): the four series + wall clock,
+    epochs_run, and the NEWEST-FIRST test-loss history the stopping
+    criterion consumes (the reference reads newest first,
+    EarlyStopping.scala:18-46)."""
+    result.losses.append(loss)
+    result.accuracies.append(acc)
+    result.test_losses.append(test_loss)
+    result.test_accuracies.append(test_acc)
+    result.epoch_seconds.append(epoch_s)
+    result.epochs_run = epoch + 1
+    test_newest_first.insert(0, test_loss)
 
 
 class SyncTrainer:
@@ -100,25 +122,20 @@ class SyncTrainer:
         test_losses_newest_first: List[float] = []
 
         start_epoch = 0
-        if self.checkpointer is not None:
-            restored = self.checkpointer.restore_latest()
-            if restored is not None:
-                from distributed_sgd_tpu.checkpoint import decode_sync_fit_state
-
-                start_epoch, state = restored
-                w = jnp.asarray(state["weights"])
-                # early-stopping continuity: the criterion sees the full
-                # newest-first test-loss history; optimizer continuity:
-                # momentum/adam buffers resume where they left off (a zeroed
-                # adam state on converged weights would bias-correct into a
-                # large first step).  Kind/shape mismatches raise (shared
-                # contract, checkpoint.decode_sync_fit_state)
-                test_losses_newest_first, opt_leaves = decode_sync_fit_state(
-                    state, self._opt_kind, bound_train.opt_state_leaves()
-                )
-                if opt_leaves:
-                    bound_train.load_opt_state_leaves(opt_leaves)
-                log.info("resumed from checkpoint at epoch %d", start_epoch)
+        restored = restore_sync_fit(
+            self.checkpointer, self._opt_kind, bound_train.opt_state_leaves())
+        if restored is not None:
+            # early-stopping continuity: the criterion sees the full
+            # newest-first test-loss history; optimizer continuity:
+            # momentum/adam buffers resume where they left off (a zeroed
+            # adam state on converged weights would bias-correct into a
+            # large first step).  Kind/shape mismatches raise (shared
+            # contract, checkpoint.decode_sync_fit_state)
+            start_epoch, w_np, test_losses_newest_first, opt_leaves = restored
+            w = jnp.asarray(w_np)
+            if opt_leaves:
+                bound_train.load_opt_state_leaves(opt_leaves)
+            log.info("resumed from checkpoint at epoch %d", start_epoch)
 
         if start_epoch >= max_epochs:
             # a resumed run that is already done must not report epochs_run=0
@@ -154,13 +171,8 @@ class SyncTrainer:
 
             loss, acc = bound_train.evaluate(w)
             test_loss, test_acc = bound_test.evaluate(w)
-            result.losses.append(loss)
-            result.accuracies.append(acc)
-            result.test_losses.append(test_loss)
-            result.test_accuracies.append(test_acc)
-            result.epoch_seconds.append(epoch_s)
-            result.epochs_run = epoch + 1
-            test_losses_newest_first.insert(0, test_loss)
+            record_epoch(result, test_losses_newest_first, epoch,
+                         loss, acc, test_loss, test_acc, epoch_s)
 
             self.metrics.histogram("master.sync.loss").record(loss)
             self.metrics.histogram("master.sync.acc").record(100 * acc)
@@ -171,8 +183,9 @@ class SyncTrainer:
             )
 
             if self.checkpointer is not None and (epoch + 1) % self.checkpoint_every == 0:
-                self.checkpointer.save(epoch + 1, w, extra=self._ckpt_extra(
-                    test_losses_newest_first, bound_train))
+                save_sync_fit(self.checkpointer, epoch + 1, w,
+                              test_losses_newest_first, self._opt_kind,
+                              bound_train.opt_state_leaves())
 
             if criterion is not None and criterion(test_losses_newest_first):
                 log.info("Converged to target: stopping computation")
@@ -180,16 +193,10 @@ class SyncTrainer:
         else:
             if max_epochs > 0:
                 log.info("Reached max number of epochs: stopping computation")
-        # the fit may end off-cadence (early stop, or max_epochs not a
-        # multiple of checkpoint_every): persist the final state so no run
-        # with a checkpointer ends unsaved
-        if (
-            self.checkpointer is not None
-            and result.epochs_run > start_epoch
-            and result.epochs_run % self.checkpoint_every != 0
-        ):
-            self.checkpointer.save(result.epochs_run, w, extra=self._ckpt_extra(
-                test_losses_newest_first, bound_train))
+        save_sync_fit_final(
+            self.checkpointer, result.epochs_run, start_epoch,
+            self.checkpoint_every, w, test_losses_newest_first,
+            self._opt_kind, bound_train.opt_state_leaves())
         if self.profile_dir is not None and not profiled:
             log.warning(
                 "no profiler trace captured: the fit stopped before epoch %d",
@@ -200,13 +207,6 @@ class SyncTrainer:
             weights=w, loss=result.losses[-1] if result.losses else float("nan")
         ).finish()
         return result
-
-    def _ckpt_extra(self, test_losses_newest_first: List[float], bound):
-        from distributed_sgd_tpu.checkpoint import sync_fit_extra
-
-        return sync_fit_extra(
-            test_losses_newest_first, self._opt_kind, bound.opt_state_leaves()
-        )
 
     def predict(self, weights: jax.Array, data: Dataset):
         """Predictions over a split (Master.predict, Master.scala:61-75)."""

@@ -391,22 +391,21 @@ class FeatureShardedEngine:
         fit contract (core/trainer.py) on the 2-D mesh.
 
         Checkpoints use the SHARED sync snapshot contract (dense weights +
-        newest-first test-loss history, checkpoint.sync_fit_extra with the
-        plain-SGD kind): a feature-sharded snapshot resumes in the 1-D
-        SyncTrainer / RPC fit_sync and vice versa.
-
-        Known debt: this mirrors SyncTrainer.fit's loop protocol (cadence
-        save, off-cadence final save, newest-first criterion history)
-        rather than sharing code — the trainer is coupled to the 1-D
-        engine's bind/opt-state surface.  The interchange contract that
-        matters is pinned by tests/test_feature_sharded.py::
-        test_fit_checkpoint_interchanges_with_sync_trainer, which fails if
-        either copy drifts.
+        newest-first test-loss history), through the same
+        checkpoint.restore_sync_fit / save_sync_fit / save_sync_fit_final
+        helpers the 1-D SyncTrainer and the RPC fit_sync use — so a
+        feature-sharded snapshot resumes in either of them and vice versa
+        (pinned by tests/test_feature_sharded.py::
+        test_fit_checkpoint_interchanges_with_sync_trainer).
         """
         import time
 
         from distributed_sgd_tpu.core.grad_state import GradState
-        from distributed_sgd_tpu.core.trainer import FitResult, log as tlog
+        from distributed_sgd_tpu.core.trainer import (
+            FitResult,
+            log as tlog,
+            record_epoch,
+        )
 
         self.bind(train)
         test_bound = FeatureShardedEngine(
@@ -419,19 +418,18 @@ class FeatureShardedEngine:
         test_newest_first = []
 
         from distributed_sgd_tpu.checkpoint import (
-            decode_sync_fit_state,
-            sync_fit_extra,
+            restore_sync_fit,
+            save_sync_fit,
+            save_sync_fit_final,
         )
 
         start_epoch = 0
-        if checkpointer is not None:
-            restored = checkpointer.restore_latest()
-            if restored is not None:
-                start_epoch, state = restored
-                w2 = self.from_dense(np.asarray(state["weights"]))
-                test_newest_first, _ = decode_sync_fit_state(state, "sgd", [])
-                tlog.info("resumed feature-sharded fit from checkpoint at "
-                          "epoch %d", start_epoch)
+        restored = restore_sync_fit(checkpointer, "sgd", [])
+        if restored is not None:
+            start_epoch, w_np, test_newest_first, _ = restored
+            w2 = self.from_dense(w_np)
+            tlog.info("resumed feature-sharded fit from checkpoint at "
+                      "epoch %d", start_epoch)
 
         if start_epoch >= max_epochs:
             loss, acc = self.evaluate(w2)
@@ -447,33 +445,22 @@ class FeatureShardedEngine:
             epoch_s = time.perf_counter() - t0
             loss, acc = self.evaluate(w2)
             test_loss, test_acc = test_bound.evaluate(w2)
-            result.losses.append(loss)
-            result.accuracies.append(acc)
-            result.test_losses.append(test_loss)
-            result.test_accuracies.append(test_acc)
-            result.epoch_seconds.append(epoch_s)
-            result.epochs_run = epoch + 1
-            test_newest_first.insert(0, test_loss)
+            record_epoch(result, test_newest_first, epoch,
+                         loss, acc, test_loss, test_acc, epoch_s)
             tlog.info(
                 "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f "
                 "(%.2fs, %d feature shards)",
                 epoch, loss, acc, test_loss, test_acc, epoch_s, self.n_shards,
             )
             if checkpointer is not None and (epoch + 1) % checkpoint_every == 0:
-                checkpointer.save(
-                    epoch + 1, jnp.asarray(self.to_dense(w2)),
-                    extra=sync_fit_extra(test_newest_first, "sgd", []))
+                save_sync_fit(checkpointer, epoch + 1, self.to_dense(w2),
+                              test_newest_first)
             if criterion is not None and criterion(test_newest_first):
                 tlog.info("Converged to target: stopping computation")
                 break
-        if (
-            checkpointer is not None
-            and result.epochs_run > start_epoch
-            and result.epochs_run % checkpoint_every != 0
-        ):
-            checkpointer.save(
-                result.epochs_run, jnp.asarray(self.to_dense(w2)),
-                extra=sync_fit_extra(test_newest_first, "sgd", []))
+        save_sync_fit_final(
+            checkpointer, result.epochs_run, start_epoch, checkpoint_every,
+            lambda: self.to_dense(w2), test_newest_first)
 
         result.state = GradState(
             weights=jnp.asarray(self.to_dense(w2)),
