@@ -63,11 +63,25 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def reload(self) -> None:
+        """Re-read the step list from disk.
+
+        orbax's CheckpointManager caches the directory listing at
+        construction and after its own saves; a process that only READS a
+        directory another process writes (the serving hot-reload poll,
+        serving/model_store.py) must drop that cache to observe new steps.
+        """
+        self._mgr.reload()
+
     def restore_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
         step = self._mgr.latest_step()
         if step is None:
             return None
-        state = self._mgr.restore(step)
+        # explicit StandardRestore args: arg-less restore() only works on a
+        # manager that already SAVED this process (saving registers the item
+        # handler as a side effect) — a restore-only process (resume at
+        # startup, the serving hot-reload poll) needs the args spelled out
+        state = self._mgr.restore(step, args=ocp.args.StandardRestore())
         state["weights"] = jnp.asarray(state["weights"])
         return step, state
 

@@ -80,6 +80,18 @@ class Config:
     # needs workers x F devices).  1 = the 1-D DP engines (default)
     feature_shards: int = 1
 
+    # -- serving role (serving/; docs/SERVING.md) --------------------------
+    # DSGD_ROLE overrides the master_host/master_port-derived role below;
+    # 'serve' is the only role with no derivation rule (a serving replica
+    # has no place in the training topology), the other three make an
+    # implicit deployment explicit.  None = derive (reference behavior).
+    role_override: Optional[str] = None
+    serve_port: int = 4100  # gRPC dsgd.Serving bind port
+    serve_max_batch: int = 64  # micro-batch flush size cap
+    serve_max_delay_ms: float = 5.0  # coalescing window from oldest queued row
+    serve_queue_depth: int = 256  # admission bound -> RESOURCE_EXHAUSTED
+    serve_ckpt_poll_s: float = 2.0  # checkpoint hot-reload poll period
+
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
         "engine": ("mesh", "rpc"),
@@ -129,10 +141,32 @@ class Config:
                 "exclusive: virtual_workers pins the per-device emulation "
                 "directly, so the exact-topology solver would be ignored"
             )
+        if self.role_override not in (None, "dev", "master", "worker", "serve"):
+            raise ValueError(
+                f"DSGD_ROLE={self.role_override!r} must be one of "
+                f"dev | master | worker | serve (unset = derive from "
+                f"master_host/master_port)"
+            )
+        if self.role_override == "serve" and not self.checkpoint_dir:
+            raise ValueError(
+                "role=serve needs checkpoint_dir (DSGD_CHECKPOINT_DIR): "
+                "serving loads and hot-reloads the trainer's checkpoints"
+            )
+        if self.serve_max_batch < 1:
+            raise ValueError("serve_max_batch must be >= 1")
+        if self.serve_max_delay_ms < 0:
+            raise ValueError("serve_max_delay_ms must be >= 0")
+        if self.serve_queue_depth < 1:
+            raise ValueError("serve_queue_depth must be >= 1")
+        if self.serve_ckpt_poll_s <= 0:
+            raise ValueError("serve_ckpt_poll_s must be > 0")
 
     @property
     def role(self) -> str:
-        """'dev' | 'master' | 'worker', per Main.scala:122-159."""
+        """'dev' | 'master' | 'worker' per Main.scala:122-159, or any of
+        those plus 'serve' when DSGD_ROLE overrides the derivation."""
+        if self.role_override is not None:
+            return self.role_override
         if self.master_host is None or self.master_port is None:
             return "dev"
         if (self.master_host, self.master_port) == (self.host, self.port):
@@ -179,6 +213,12 @@ class Config:
             momentum=_env("DSGD_MOMENTUM", cls.momentum, float),
             steps_per_dispatch=_env("DSGD_STEPS_PER_DISPATCH", cls.steps_per_dispatch, int),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
+            role_override=_env("DSGD_ROLE", None, str),
+            serve_port=_env("DSGD_SERVE_PORT", cls.serve_port, int),
+            serve_max_batch=_env("DSGD_SERVE_MAX_BATCH", cls.serve_max_batch, int),
+            serve_max_delay_ms=_env("DSGD_SERVE_MAX_DELAY_MS", cls.serve_max_delay_ms, float),
+            serve_queue_depth=_env("DSGD_SERVE_QUEUE_DEPTH", cls.serve_queue_depth, int),
+            serve_ckpt_poll_s=_env("DSGD_SERVE_CKPT_POLL_S", cls.serve_ckpt_poll_s, float),
         )
         return dataclasses.replace(cfg, **overrides)
 

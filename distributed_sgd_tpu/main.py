@@ -7,6 +7,9 @@ is driven entirely by DSGD_* env config.  Role selection
 - master_host/master_port unset        -> dev mode (in-process cluster)
 - (master_host, master_port) == self   -> master process
 - otherwise                            -> worker process
+- DSGD_ROLE overrides the derivation; DSGD_ROLE=serve (the only role with
+  no derivation rule) runs the online-inference front end over the
+  trainer's checkpoints (serving/, docs/SERVING.md)
 
 Dev mode picks the execution engine via DSGD_ENGINE:
 
@@ -299,6 +302,26 @@ def main() -> None:
 
 
 def _run_role(cfg: Config, role: str) -> None:
+    if role == "serve":
+        # Online inference front end (serving/; DSGD_ROLE=serve): no
+        # training data, no cluster membership — it loads weights from
+        # cfg.checkpoint_dir and hot-reloads as the trainer saves new ones.
+        from distributed_sgd_tpu.serving.server import ServingServer
+
+        server = ServingServer.from_config(
+            cfg, metrics=metrics_mod.global_metrics()).start()
+        log.info(
+            "serving model=%s on :%d (ckpt=%s, max_batch=%d, "
+            "max_delay_ms=%g, queue_depth=%d)",
+            cfg.model, server.bound_port, cfg.checkpoint_dir,
+            cfg.serve_max_batch, cfg.serve_max_delay_ms,
+            cfg.serve_queue_depth,
+        )
+        try:
+            server.await_termination()
+        finally:
+            server.stop()
+        return
     if role == "dev":
         train, test, model = build(cfg)
         if cfg.engine == "rpc":

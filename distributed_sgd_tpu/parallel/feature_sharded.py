@@ -60,7 +60,7 @@ from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
 from distributed_sgd_tpu.ops import mxu
 from distributed_sgd_tpu.ops.sparse import SparseBatch
-from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS
+from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS, pcast_varying, shard_map
 from distributed_sgd_tpu.parallel.sync import _pad_to_exact, padded_layout
 
 WORKERS, FEATURES = WORKER_AXIS, "features"
@@ -229,8 +229,8 @@ class FeatureShardedEngine:
             return (loss_acc + jnp.sum(losses * mask),
                     hit_acc + jnp.sum(hits.astype(jnp.float32) * mask)), ()
 
-        init = jax.lax.pcast(
-            (jnp.float32(0), jnp.float32(0)), (WORKERS,), to="varying")
+        init = pcast_varying(
+            (jnp.float32(0), jnp.float32(0)), (WORKERS,))
         (loss_sum, hit_sum), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
         return jax.lax.psum(jnp.stack([loss_sum, hit_sum]), WORKERS)
 
@@ -309,7 +309,7 @@ class FeatureShardedEngine:
                         P(), wspec)
 
         self._epoch = jax.jit(
-            jax.shard_map(
+            shard_map(
                 epoch_shard, mesh=self.mesh, in_specs=in_specs, out_specs=wspec
             )
         )
@@ -320,12 +320,12 @@ class FeatureShardedEngine:
             eval_in = (wspec, P(WORKERS, None), P(WORKERS, None), P(WORKERS))
             pred_in = (wspec, P(WORKERS, None), P(WORKERS, None))
         self._eval_sm = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._eval_shard, mesh=self.mesh, in_specs=eval_in, out_specs=P()
             )
         )
         self._predict_sm = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._predict_shard, mesh=self.mesh, in_specs=pred_in,
                 out_specs=P(WORKERS),
             )

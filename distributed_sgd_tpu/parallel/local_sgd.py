@@ -35,7 +35,7 @@ from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
 from distributed_sgd_tpu.ops import mxu
 from distributed_sgd_tpu.ops.sparse import SparseBatch
-from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS as AXIS
+from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS as AXIS, pcast_varying, shard_map
 from distributed_sgd_tpu.parallel.sync import SyncEngine
 from distributed_sgd_tpu.utils import metrics as metrics_mod
 
@@ -134,9 +134,9 @@ class LocalSGDEngine:
             # float optimizer leaves via pmean (the gossip, collapsed);
             # integer leaves (e.g. adam's count) advance identically on
             # every replica, so pmax just re-asserts their invariance
-            w_var = jax.lax.pcast(w, (AXIS,), to="varying")
+            w_var = pcast_varying(w, (AXIS,))
             opt_var = jax.tree.map(
-                lambda x: jax.lax.pcast(x, (AXIS,), to="varying"), opt_state)
+                lambda x: pcast_varying(x, (AXIS,)), opt_state)
             (wl, opt_state), _ = jax.lax.scan(body, (w_var, opt_var), jnp.arange(h))
             wl = jax.lax.pmean(wl, AXIS)
             opt_state = jax.tree.map(
@@ -147,7 +147,7 @@ class LocalSGDEngine:
             return mxu.from_blocked(wl, n_features) if blocked else wl, opt_state
 
         round_fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 round_shard,
                 mesh=self.mesh,
                 in_specs=(P(), P(), P(AXIS), P(AXIS), P(AXIS), P()),

@@ -65,7 +65,7 @@ from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
 from distributed_sgd_tpu.ops import mxu
 from distributed_sgd_tpu.ops.sparse import SparseBatch
-from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS
+from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS, pcast_varying, shard_map
 
 AXIS = WORKER_AXIS
 
@@ -161,7 +161,7 @@ class BoundSync:
 
         dspec = (P(AXIS), P(AXIS), P(AXIS))
         self._epoch = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._epoch_shard,
                 mesh=mesh,
                 in_specs=(P(), sspec) + dspec + (P(),),
@@ -170,7 +170,7 @@ class BoundSync:
             )
         )
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._step_shard,
                 mesh=mesh,
                 in_specs=(P(), sspec) + dspec + (P(),),
@@ -180,7 +180,7 @@ class BoundSync:
         )
         self._sspec = sspec
         self._eval = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._eval_shard,
                 mesh=mesh,
                 in_specs=(P(),) + dspec,
@@ -189,7 +189,7 @@ class BoundSync:
             )
         )
         self._predict = jax.jit(
-            jax.shard_map(
+            shard_map(
                 self._predict_shard,
                 mesh=mesh,
                 in_specs=(P(),) + dspec[:2],
@@ -359,7 +359,7 @@ class BoundSync:
             hits = (preds == cy.astype(jnp.float32)).astype(jnp.float32)
             return (loss_acc + jnp.sum(losses * mask), hit_acc + jnp.sum(hits * mask)), ()
 
-        init = jax.lax.pcast((jnp.float32(0), jnp.float32(0)), (AXIS,), to="varying")
+        init = pcast_varying((jnp.float32(0), jnp.float32(0)), (AXIS,))
         (loss_sum, hit_sum), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
         return jax.lax.psum(jnp.stack([loss_sum, hit_sum]), AXIS)
 
@@ -441,7 +441,7 @@ class BoundSync:
             import functools
 
             self._multi_cache[n_epochs] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     functools.partial(self._multi_epoch_shard, n_epochs),
                     mesh=self.mesh,
                     in_specs=(P(), self._sspec) + (P(AXIS), P(AXIS), P(AXIS)) + (P(),),

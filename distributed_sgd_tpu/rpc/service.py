@@ -33,6 +33,13 @@ _WORKER_METHODS = {
     "UpdateGrad": (pb.GradUpdate, pb.Ack),
 }
 
+# The inference front end (serving/): no reference counterpart — the
+# reference's only inference surface is the in-fit Forward above.
+_SERVE_METHODS = {
+    "Predict": (pb.PredictRequest, pb.PredictReply),
+    "ServeHealth": (pb.Empty, pb.ServeHealthReply),
+}
+
 
 def _add_servicer(server, servicer, service_name: str, methods: dict) -> None:
     handlers = {}
@@ -52,6 +59,10 @@ def add_master_servicer(server, servicer) -> None:
 
 def add_worker_servicer(server, servicer) -> None:
     _add_servicer(server, servicer, "dsgd.Worker", _WORKER_METHODS)
+
+
+def add_serve_servicer(server, servicer) -> None:
+    _add_servicer(server, servicer, "dsgd.Serving", _SERVE_METHODS)
 
 
 class _Stub:
@@ -76,6 +87,11 @@ class MasterStub(_Stub):
 class WorkerStub(_Stub):
     def __init__(self, channel):
         super().__init__(channel, "dsgd.Worker", _WORKER_METHODS)
+
+
+class ServeStub(_Stub):
+    def __init__(self, channel):
+        super().__init__(channel, "dsgd.Serving", _SERVE_METHODS)
 
 
 class GossipSender:

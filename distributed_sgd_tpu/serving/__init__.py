@@ -1,0 +1,35 @@
+"""TPU-native online-serving subsystem (no reference counterpart).
+
+The reference is training-only: its single inference surface is the
+per-batch `Forward` RPC used inside the sync fit loop (proto.proto:56-58).
+This package opens the online workload the ROADMAP north star asks for —
+answering single-row prediction requests at serving latency from the same
+jitted sparse forward pass the trainers use:
+
+- `batcher.MicroBatcher`: Clipper-style dynamic micro-batching — concurrent
+  single-row requests coalesce into one padded device batch under a
+  max-latency deadline, with a bounded admission queue (backpressure
+  instead of unbounded latency);
+- `bucketing`: powers-of-two (batch, nnz) shape buckets so the jit cache
+  stays small and warm;
+- `model_store.ModelStore`: loads `checkpoint.py`-format snapshots and
+  hot-swaps them atomically when the trainer saves a new one — no restart;
+- `server.ServingServer`: the gRPC `dsgd.Serving` front end
+  (Predict/ServeHealth, rpc/service.py method table), wired into main.py
+  as the `DSGD_ROLE=serve` role;
+- `health_probe`: exec-style readiness probe for kube/serve.yaml.
+
+Design + backpressure contract: docs/SERVING.md.
+"""
+
+from distributed_sgd_tpu.serving.batcher import MicroBatcher, QueueFull
+from distributed_sgd_tpu.serving.model_store import ModelStore
+from distributed_sgd_tpu.serving.server import PredictEngine, ServingServer
+
+__all__ = [
+    "MicroBatcher",
+    "ModelStore",
+    "PredictEngine",
+    "QueueFull",
+    "ServingServer",
+]

@@ -1,0 +1,103 @@
+"""Checkpoint-backed model store with atomic hot-swap.
+
+Serves the model the trainer just saved, with no server restart: a
+background poll re-reads the checkpoint directory (`Checkpointer.reload`)
+every `poll_s` seconds and, when a newer step appears, restores its
+weights and swaps the published snapshot in one reference assignment.
+Readers (`get()`) always see a complete (step, weights) pair — a flush
+that started on step N finishes on step N even if N+1 lands mid-batch,
+and the NEXT flush picks up N+1.
+
+All checkpoint formats in this repo interchange through the same snapshot
+contract (checkpoint.py): every snapshot carries a dense `weights` vector,
+which is the only key serving needs — optimizer state and early-stop
+history are ignored.
+
+A restore that fails (e.g. the poll raced a half-committed write before
+orbax finalized it) keeps the previous snapshot and counts
+`serve.model.reload.errors`; successful swaps count `serve.model.reload`.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+log = logging.getLogger("dsgd.serving")
+
+
+class ModelStore:
+    def __init__(self, checkpoint_dir: str, poll_s: float = 2.0, metrics=None):
+        from distributed_sgd_tpu.checkpoint import Checkpointer
+
+        if poll_s <= 0:
+            raise ValueError("poll_s must be > 0")
+        self._ckpt = Checkpointer(checkpoint_dir)
+        self.poll_s = float(poll_s)
+        self._metrics = metrics
+        # the published snapshot; swapped by ONE reference assignment, so
+        # readers never lock
+        self._current: Optional[Tuple[int, jnp.ndarray]] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serve-ckpt-poll")
+        self.poll_once()  # serve immediately if a snapshot already exists
+
+    # -- readers -------------------------------------------------------------
+
+    def get(self) -> Optional[Tuple[int, jnp.ndarray]]:
+        """(step, weights) of the newest loaded snapshot, or None before the
+        first checkpoint lands."""
+        return self._current
+
+    @property
+    def step(self) -> Optional[int]:
+        cur = self._current
+        return cur[0] if cur is not None else None
+
+    # -- the poll ------------------------------------------------------------
+
+    def poll_once(self) -> bool:
+        """Check for a newer checkpoint; swap it in.  True iff swapped."""
+        cur = self._current
+        try:
+            self._ckpt.reload()
+            step = self._ckpt.latest_step()
+            if step is None or (cur is not None and step <= cur[0]):
+                return False
+            restored = self._ckpt.restore_latest()
+            if restored is None:  # deleted between listing and restore
+                return False
+            step, state = restored
+            weights = jnp.asarray(state["weights"], dtype=jnp.float32)
+        except Exception as e:  # noqa: BLE001 - keep serving the old snapshot
+            log.warning("checkpoint reload failed (serving stays on step %s): %s",
+                        cur[0] if cur else None, e)
+            if self._metrics is not None:
+                self._metrics.counter("serve.model.reload.errors").increment()
+            return False
+        self._current = (step, weights)
+        if self._metrics is not None:
+            self._metrics.counter("serve.model.reload").increment()
+        log.info("serving model hot-swapped to checkpoint step %d (%d features)",
+                 step, weights.shape[0])
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.poll_once()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ModelStore":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=self.poll_s + 1.0)
+        self._ckpt.close()

@@ -21,8 +21,10 @@ from __future__ import annotations
 
 import http.server
 import math
+import random
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional
 
 
@@ -44,14 +46,22 @@ class Counter:
 
 
 class Histogram:
-    """Streaming histogram: count/sum/min/max/mean + last value.
+    """Streaming histogram: count/sum/min/max/mean/last + quantiles.
 
-    The reference's Kamon histograms feed Grafana percentile panels; here we
-    keep cheap streaming aggregates (enough for the same dashboards) rather
-    than full HDR buckets.
+    The reference's Kamon histograms feed Grafana percentile panels; the
+    cheap streaming aggregates cover mean-style dashboards, and a fixed-size
+    uniform reservoir (Vitter's algorithm R, 512 slots) adds p50/p95/p99 —
+    serving latency SLOs are unreadable without percentiles.  Exact while
+    count <= 512, an unbiased uniform sample of the full stream after; both
+    exporters emit the estimates.  The reservoir RNG is seeded from the
+    instrument name, so a replayed value stream reproduces its quantiles.
     """
 
-    __slots__ = ("name", "count", "sum", "min", "max", "last", "_lock")
+    RESERVOIR_SIZE = 512
+    QUANTILES = (0.5, 0.95, 0.99)
+
+    __slots__ = ("name", "count", "sum", "min", "max", "last", "_reservoir",
+                 "_rng", "_lock")
 
     def __init__(self, name: str):
         self.name = name
@@ -60,6 +70,8 @@ class Histogram:
         self.min = math.inf
         self.max = -math.inf
         self.last = float("nan")
+        self._reservoir: List[float] = []
+        self._rng = random.Random(zlib.crc32(name.encode()))
         self._lock = threading.Lock()
 
     def record(self, v: float) -> None:
@@ -70,10 +82,34 @@ class Histogram:
             self.min = min(self.min, v)
             self.max = max(self.max, v)
             self.last = v
+            if len(self._reservoir) < self.RESERVOIR_SIZE:
+                self._reservoir.append(v)
+            else:  # algorithm R: keep slot j with probability SIZE/count
+                j = self._rng.randrange(self.count)
+                if j < self.RESERVOIR_SIZE:
+                    self._reservoir[j] = v
 
     @property
     def mean(self) -> float:
         return self.sum / self.count if self.count else float("nan")
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (exact while count <= reservoir size).
+        Linear interpolation between order statistics; NaN when empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} must be in [0, 1]")
+        with self._lock:
+            snap = sorted(self._reservoir)
+        if not snap:
+            return float("nan")
+        pos = q * (len(snap) - 1)
+        lo = int(math.floor(pos))
+        hi = min(lo + 1, len(snap) - 1)
+        return snap[lo] + (snap[hi] - snap[lo]) * (pos - lo)
+
+    def quantiles(self) -> Dict[float, float]:
+        """{q: estimate} for the exported QUANTILES (p50/p95/p99)."""
+        return {q: self.quantile(q) for q in self.QUANTILES}
 
 
 class Timer:
@@ -127,6 +163,12 @@ class Metrics:
         for h in list(self._hists.values()):
             base = mangle(h.name)
             lines.append(f"# TYPE {base} summary")
+            if h.count:
+                # quantile samples join the summary family with the
+                # reserved `quantile` label merged into the shared tags
+                for q, est in h.quantiles().items():
+                    qtags = ",".join(filter(None, [tags, f'quantile="{q}"']))
+                    lines.append(f"{base}{{{qtags}}} {est}")
             lines.append(f"{base}_count{tagstr} {h.count}")
             lines.append(f"{base}_sum{tagstr} {h.sum}")
             if h.count:
@@ -147,9 +189,12 @@ class Metrics:
             lines.append(f"{c.name}{tags} value={c.value}i {ts}")
         for h in list(self._hists.values()):
             if h.count:
+                qs = h.quantiles()
+                qfields = ",".join(
+                    f"p{int(q * 100)}={est}" for q, est in qs.items())
                 lines.append(
                     f"{h.name}{tags} count={h.count}i,sum={h.sum},"
-                    f"min={h.min},max={h.max},mean={h.mean} {ts}"
+                    f"min={h.min},max={h.max},mean={h.mean},{qfields} {ts}"
                 )
         return "\n".join(lines) + ("\n" if lines else "")
 

@@ -37,3 +37,13 @@ def test_feature_sharded_example():
 
     loss = main(n=800, max_epochs=2)
     assert np.isfinite(loss)
+
+
+def test_serve_predict_example():
+    from examples.serve_predict import main
+
+    # returns the max micro-batch size; > 1 proves concurrent requests
+    # were observably coalesced (the example itself asserts served
+    # answers match direct model.predict on the checkpointed weights)
+    max_batch = main(n=800, max_epochs=1, n_requests=24)
+    assert max_batch > 1

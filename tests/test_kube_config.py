@@ -22,7 +22,8 @@ def _known_env_keys():
 
 def _manifest_keys():
     keys = set()
-    for name in ("config-sync.yaml", "config-async.yaml", "dsgd.yaml", "monitor.yaml"):
+    for name in ("config-sync.yaml", "config-async.yaml", "dsgd.yaml",
+                 "monitor.yaml", "serve.yaml"):
         path = os.path.join(REPO, "kube", name)
         for doc in yaml.safe_load_all(open(path)):
             if not doc:

@@ -98,3 +98,57 @@ def test_dev_rpc_sync_checkpoint_resume(tmp_path):
         "DSGD_ENGINE": "rpc", "DSGD_CHECKPOINT_DIR": ck, "DSGD_MAX_EPOCHS": "2",
     })
     assert "nothing to run" in out3
+
+
+def test_serve_role_end_to_end(tmp_path):
+    """DSGD_ROLE=serve through the real entry point: train+checkpoint via a
+    dev run, start the serving role as a subprocess, wait for readiness
+    via the health probe, round-trip a Predict, shut down cleanly."""
+    import socket
+    import time
+
+    ck = str(tmp_path / "ck")
+    run_main(tmp_path, {"DSGD_CHECKPOINT_DIR": ck})  # writes the snapshot
+
+    with socket.socket() as s:  # free port for the serving subprocess
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "DSGD_ROLE": "serve",
+        "DSGD_CHECKPOINT_DIR": ck,
+        "DSGD_SERVE_PORT": str(port),
+        "DSGD_SERVE_CKPT_POLL_S": "0.2",
+    })
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distributed_sgd_tpu.main"],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        from distributed_sgd_tpu.serving.health_probe import probe
+
+        deadline = time.time() + 120
+        while time.time() < deadline and not probe(port):
+            assert proc.poll() is None, proc.stdout.read()[-3000:]
+            time.sleep(0.25)
+        assert probe(port), "serve role never became ready"
+
+        from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+        from distributed_sgd_tpu.rpc.service import ServeStub, new_channel
+
+        channel = new_channel("127.0.0.1", port)
+        reply = ServeStub(channel).Predict(
+            pb.PredictRequest(indices=[1], values=[1.0]), timeout=30)
+        channel.close()
+        assert reply.model_step >= 1
+        assert reply.prediction in (-1.0, 0.0, 1.0)  # hinge label space
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=20)

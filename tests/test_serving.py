@@ -1,0 +1,511 @@
+"""Serving subsystem: micro-batcher coalescing/deadline/backpressure,
+bucket-padding jit-cache reuse, checkpoint hot-swap mid-traffic, the gRPC
+Predict/ServeHealth round-trip, config knobs, and histogram quantiles."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.serving.batcher import MicroBatcher, QueueFull
+from distributed_sgd_tpu.serving.bucketing import bucket_dim, bucket_shape, pack_rows
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+
+def _echo_rows(rows):
+    """run_batch stub: each row's result is its own (indices, values)."""
+    return [(r.indices.copy(), r.values.copy()) for r in rows]
+
+
+# -- micro-batcher ----------------------------------------------------------
+
+
+def test_batcher_coalesces_concurrent_requests():
+    m = Metrics()
+    seen_sizes = []
+
+    gate = threading.Event()
+
+    def run(rows):
+        gate.wait(5)  # hold the first flush until every request is queued
+        seen_sizes.append(len(rows))
+        return _echo_rows(rows)
+
+    b = MicroBatcher(run, max_batch=8, max_delay_ms=50.0, queue_depth=64,
+                     metrics=m).start()
+    pendings = [
+        b.submit(np.array([i], np.int32), np.array([1.0], np.float32))
+        for i in range(8)
+    ]
+    gate.set()
+    results = [p.wait(5) for p in pendings]
+    b.stop()
+    # request i got ITS row back, in submit order
+    for i, (idx, _) in enumerate(results):
+        assert idx[0] == i
+    assert max(seen_sizes) > 1  # observably coalesced
+    assert m.histogram("serve.batch.size").max > 1
+
+
+def test_batcher_deadline_flushes_partial_batch():
+    b = MicroBatcher(_echo_rows, max_batch=1000, max_delay_ms=20.0,
+                     queue_depth=64).start()
+    t0 = time.monotonic()
+    p = b.submit(np.array([7], np.int32), np.array([2.0], np.float32))
+    idx, val = p.wait(5)  # far under max_batch: only the deadline can flush
+    elapsed = time.monotonic() - t0
+    b.stop()
+    assert idx[0] == 7 and val[0] == 2.0
+    assert elapsed < 2.0  # flushed by deadline, not by a full batch
+
+
+def test_batcher_queue_full_rejects_and_counts():
+    m = Metrics()
+    release = threading.Event()
+
+    def slow(rows):
+        release.wait(10)
+        return _echo_rows(rows)
+
+    b = MicroBatcher(slow, max_batch=1, max_delay_ms=0.0, queue_depth=2,
+                     metrics=m).start()
+    row = (np.array([0], np.int32), np.array([1.0], np.float32))
+    admitted = [b.submit(*row)]  # taken by the (blocked) batcher thread
+    deadline = time.monotonic() + 5
+    with pytest.raises(QueueFull):
+        while time.monotonic() < deadline:  # fill the bounded queue
+            admitted.append(b.submit(*row))
+    assert m.counter("serve.rejected").value >= 1
+    release.set()
+    for p in admitted:  # already-admitted rows still get answers
+        p.wait(5)
+    b.stop()
+
+
+def test_batcher_error_fails_batch_not_server():
+    calls = []
+
+    def flaky(rows):
+        calls.append(len(rows))
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return _echo_rows(rows)
+
+    b = MicroBatcher(flaky, max_batch=4, max_delay_ms=1.0, queue_depth=16).start()
+    p1 = b.submit(np.array([1], np.int32), np.array([1.0], np.float32))
+    with pytest.raises(RuntimeError, match="boom"):
+        p1.wait(5)
+    p2 = b.submit(np.array([2], np.int32), np.array([1.0], np.float32))
+    idx, _ = p2.wait(5)  # the batcher survived the failed batch
+    assert idx[0] == 2
+    b.stop()
+
+
+# -- bucketing --------------------------------------------------------------
+
+
+def test_bucket_dims_power_of_two_with_floor():
+    assert bucket_dim(1, 4) == 4
+    assert bucket_dim(4, 4) == 4
+    assert bucket_dim(5, 4) == 8
+    assert bucket_dim(100, 8) == 128
+    assert bucket_shape(3, 9) == (4, 16)
+
+
+def test_pack_rows_pads_inert_cells():
+    rows = [
+        (np.array([3, 5], np.int32), np.array([1.0, 2.0], np.float32)),
+        (np.array([1], np.int32), np.array([4.0], np.float32)),
+    ]
+    idx, val = pack_rows(rows)
+    assert idx.shape == val.shape == (4, 8)  # floors: batch 4, nnz 8
+    np.testing.assert_array_equal(idx[0, :2], [3, 5])
+    assert val[1, 0] == 4.0
+    assert (val[2:] == 0).all() and (idx[:, 2:] == 0).all()
+
+
+def test_jit_cache_stays_flat_within_bucket(tmp_path):
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.serving.batcher import PendingRequest
+    from distributed_sgd_tpu.serving.server import PredictEngine
+
+    m = Metrics()
+    engine = PredictEngine("hinge", metrics=m)
+    w = np.linspace(-1, 1, 32).astype(np.float32)
+    snap = (1, np.asarray(w))
+
+    def rows(n, nnz):
+        return [
+            PendingRequest(np.arange(nnz, dtype=np.int32),
+                           np.ones(nnz, np.float32))
+            for _ in range(n)
+        ]
+
+    engine.run(snap, rows(3, 5))
+    compiles = m.counter("serve.jit.compile").value
+    assert compiles == 1
+    # same (batch, nnz) bucket despite different raw shapes: 1..4 rows all
+    # bucket to 4; nnz 1..8 all bucket to 8 -> the cached program is reused
+    engine.run(snap, rows(4, 2))
+    engine.run(snap, rows(1, 8))
+    assert m.counter("serve.jit.compile").value == compiles
+    # a genuinely new bucket compiles once
+    engine.run(snap, rows(5, 5))
+    assert m.counter("serve.jit.compile").value == compiles + 1
+
+
+def test_engine_revalidates_rows_against_flush_snapshot():
+    """Admission validated against the snapshot live at enqueue; if a
+    hot-swap shrinks the feature dim before the flush, the row must come
+    back as InvalidRow — not silently clamp indices into wrong answers."""
+    from distributed_sgd_tpu.serving.batcher import PendingRequest
+    from distributed_sgd_tpu.serving.server import InvalidRow, PredictEngine
+
+    engine = PredictEngine("hinge")
+    small = (2, np.ones(4, np.float32))  # the swapped-in, smaller model
+    ok_row = PendingRequest(np.array([1], np.int32), np.array([1.0], np.float32))
+    stale_row = PendingRequest(np.array([9], np.int32), np.array([1.0], np.float32))
+    ok, stale = engine.run(small, [ok_row, stale_row])
+    assert ok == (pytest.approx(-1.0), pytest.approx(1.0), 2)
+    assert isinstance(stale, InvalidRow)
+
+
+# -- model store hot-swap ---------------------------------------------------
+
+
+def _save(tmp_path, step, w):
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(tmp_path))
+    ck.save(step, w)
+    ck.close()
+
+
+def test_model_store_loads_and_hot_swaps(tmp_path):
+    from distributed_sgd_tpu.serving.model_store import ModelStore
+
+    w1 = np.arange(8, dtype=np.float32)
+    _save(tmp_path, 1, w1)
+    m = Metrics()
+    store = ModelStore(str(tmp_path), poll_s=30.0, metrics=m)  # poll manually
+    step, w = store.get()
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(w), w1)
+
+    assert not store.poll_once()  # nothing new
+    _save(tmp_path, 2, w1 * 3)
+    assert store.poll_once()
+    step, w = store.get()
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(w), w1 * 3)
+    assert m.counter("serve.model.reload").value == 2  # init load + swap
+    store.stop()
+
+
+def test_model_store_empty_directory_serves_nothing(tmp_path):
+    from distributed_sgd_tpu.serving.model_store import ModelStore
+
+    store = ModelStore(str(tmp_path / "empty"), poll_s=30.0)
+    assert store.get() is None and store.step is None
+    store.stop()
+
+
+# -- end-to-end gRPC --------------------------------------------------------
+
+
+@pytest.fixture
+def serving_stack(tmp_path):
+    """A ServingServer on a free port over a fresh checkpoint dir, plus a
+    connected stub; yields (server, stub, metrics, save_fn)."""
+    from distributed_sgd_tpu.rpc.service import ServeStub, new_channel
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    m = Metrics()
+    server = ServingServer(
+        str(tmp_path), model="hinge", port=0, host="127.0.0.1",
+        max_batch=8, max_delay_ms=5.0, queue_depth=32, ckpt_poll_s=0.1,
+        metrics=m,
+    )
+    channel = None
+    try:
+        server.start()
+        channel = new_channel("127.0.0.1", server.bound_port)
+        yield server, ServeStub(channel), m, lambda step, w: _save(tmp_path, step, w)
+    finally:
+        if channel is not None:
+            channel.close()
+        server.stop()
+
+
+def test_grpc_predict_round_trip_matches_direct_model(serving_stack):
+    from distributed_sgd_tpu.models.linear import make_model
+    from distributed_sgd_tpu.ops.sparse import SparseBatch, matvec
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+    server, stub, m, save = serving_stack
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=64).astype(np.float32)
+    save(1, w)
+    assert server.store.poll_once() or server.store.step == 1
+
+    model = make_model("hinge", 1e-5, 64, regularizer="l2")
+    import jax.numpy as jnp
+
+    idx = np.array([2, 17, 40], np.int32)
+    val = np.array([0.5, -1.0, 2.0], np.float32)
+    reply = stub.Predict(pb.PredictRequest(indices=idx, values=val), timeout=15)
+    direct_margin = float(matvec(
+        SparseBatch(jnp.asarray(idx[None]), jnp.asarray(val[None])),
+        jnp.asarray(w))[0])
+    direct_pred = float(np.asarray(model.predict(jnp.asarray([direct_margin])))[0])
+    assert reply.margin == pytest.approx(direct_margin, abs=1e-5)
+    assert reply.prediction == direct_pred
+    assert reply.model_step == 1
+
+    health = stub.ServeHealth(pb.Empty(), timeout=5)
+    assert health.ok and health.model_step == 1
+    assert m.histogram("serve.predict.duration").count >= 1
+
+
+def test_grpc_unavailable_before_first_checkpoint(serving_stack):
+    import grpc
+
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+    _, stub, _, _ = serving_stack
+    health = stub.ServeHealth(pb.Empty(), timeout=5)
+    assert not health.ok
+    with pytest.raises(grpc.RpcError) as err:
+        stub.Predict(pb.PredictRequest(indices=[0], values=[1.0]), timeout=5)
+    assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+
+
+def test_grpc_invalid_feature_index_rejected(serving_stack):
+    import grpc
+
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+    server, stub, _, save = serving_stack
+    save(1, np.ones(16, np.float32))
+    server.store.poll_once()
+    with pytest.raises(grpc.RpcError) as err:
+        stub.Predict(pb.PredictRequest(indices=[16], values=[1.0]), timeout=5)
+    assert err.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+
+
+def test_grpc_checkpoint_hot_swap_mid_traffic(serving_stack):
+    """Predicts keep flowing while a new checkpoint lands; answers flip to
+    the new weights with no restart and no failed request."""
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+    server, stub, _, save = serving_stack
+    w1 = np.ones(32, np.float32)
+    save(1, w1)
+    server.store.poll_once()
+
+    stop = threading.Event()
+    failures = []
+    steps_seen = set()
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                r = stub.Predict(
+                    pb.PredictRequest(indices=[3], values=[1.0]), timeout=15)
+                steps_seen.add(r.model_step)
+            except Exception as e:  # noqa: BLE001 - collected for the assert
+                failures.append(e)
+
+    threads = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    save(2, w1 * -5.0)  # the poll thread (0.1 s) picks this up under fire
+    deadline = time.time() + 20
+    while time.time() < deadline and 2 not in steps_seen:
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not failures, failures[:3]
+    assert {1, 2} <= steps_seen  # served from both snapshots, no restart
+    r = stub.Predict(pb.PredictRequest(indices=[3], values=[1.0]), timeout=15)
+    assert r.model_step == 2 and r.margin == pytest.approx(-5.0, abs=1e-5)
+
+
+def test_grpc_queue_full_returns_resource_exhausted(tmp_path):
+    """A wedged model + bounded queue must shed with RESOURCE_EXHAUSTED,
+    not queue unboundedly."""
+    import grpc
+
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+    from distributed_sgd_tpu.rpc.service import (
+        ServeStub, add_serve_servicer, new_channel, new_server,
+    )
+    from distributed_sgd_tpu.serving.batcher import MicroBatcher
+    from distributed_sgd_tpu.serving.model_store import ModelStore
+    from distributed_sgd_tpu.serving.server import ServingServicer
+
+    _save(tmp_path, 1, np.ones(8, np.float32))
+    m = Metrics()
+    store = ModelStore(str(tmp_path), poll_s=30.0, metrics=m)
+    release = threading.Event()
+
+    def wedged(rows):
+        release.wait(30)
+        return [(0.0, 0.0, 1) for _ in rows]
+
+    batcher = MicroBatcher(wedged, max_batch=1, max_delay_ms=0.0,
+                           queue_depth=2, metrics=m).start()
+    server = new_server(0, host="127.0.0.1")
+    add_serve_servicer(server, ServingServicer(store, batcher, metrics=m,
+                                               request_timeout_s=30.0))
+    server.start()
+    channel = new_channel("127.0.0.1", server.bound_port)
+    stub = ServeStub(channel)
+    req = pb.PredictRequest(indices=[0], values=[1.0])
+    try:
+        inflight = [stub.Predict.future(req) for _ in range(12)]
+        deadline = time.time() + 10
+        exhausted = 0
+        while time.time() < deadline and not exhausted:
+            exhausted = sum(
+                1 for f in inflight
+                if f.done() and f.exception() is not None
+                and f.exception().code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            )
+            time.sleep(0.05)
+        assert exhausted, "no request was shed with RESOURCE_EXHAUSTED"
+        assert m.counter("serve.rejected").value >= 1
+        release.set()
+        for f in inflight:  # admitted requests complete once unwedged
+            if f.exception() is None:
+                f.result(timeout=15)
+    finally:
+        release.set()
+        channel.close()
+        server.stop(0).wait()
+        batcher.stop()
+        store.stop()
+
+
+@pytest.mark.slow
+def test_sustained_load_all_answers_correct(serving_stack):
+    """200 concurrent-ish requests across 8 client threads: every answer
+    matches direct math, latency percentiles are recorded, and the jit
+    cache converges (no compile after warmup at fixed bucket)."""
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+    server, stub, m, save = serving_stack
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=128).astype(np.float32)
+    save(1, w)
+    server.store.poll_once()
+
+    errors = []
+
+    def client(k):
+        r = np.random.default_rng(k)
+        for _ in range(25):
+            nnz = int(r.integers(1, 8))
+            idx = r.choice(128, size=nnz, replace=False).astype(np.int32)
+            val = r.normal(size=nnz).astype(np.float32)
+            reply = stub.Predict(
+                pb.PredictRequest(indices=idx, values=val), timeout=30)
+            want = float((w[idx] * val).sum())
+            if abs(reply.margin - want) > 1e-4:
+                errors.append((idx, reply.margin, want))
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    dur = m.histogram("serve.predict.duration")
+    assert dur.count == 200
+    assert np.isfinite(dur.quantile(0.5)) and np.isfinite(dur.quantile(0.99))
+    # nnz buckets to 8, batch to <= 8: at most a handful of programs
+    assert m.counter("serve.jit.compile").value <= 8
+
+
+# -- serving config knobs ---------------------------------------------------
+
+
+def test_config_serve_knobs_env_and_validation(monkeypatch):
+    from distributed_sgd_tpu.config import Config
+
+    for key, value in {
+        "DSGD_ROLE": "serve", "DSGD_CHECKPOINT_DIR": "/tmp/ck",
+        "DSGD_SERVE_PORT": "4242", "DSGD_SERVE_MAX_BATCH": "16",
+        "DSGD_SERVE_MAX_DELAY_MS": "2.5", "DSGD_SERVE_QUEUE_DEPTH": "64",
+        "DSGD_SERVE_CKPT_POLL_S": "0.5",
+    }.items():
+        monkeypatch.setenv(key, value)
+    cfg = Config.from_env()
+    assert cfg.role == "serve"
+    assert (cfg.serve_port, cfg.serve_max_batch, cfg.serve_max_delay_ms,
+            cfg.serve_queue_depth, cfg.serve_ckpt_poll_s) == (4242, 16, 2.5, 64, 0.5)
+
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Config(role_override="serve")
+    with pytest.raises(ValueError, match="DSGD_ROLE"):
+        Config(role_override="conductor")
+    with pytest.raises(ValueError, match="serve_max_batch"):
+        Config(serve_max_batch=0)
+    with pytest.raises(ValueError, match="serve_queue_depth"):
+        Config(serve_queue_depth=0)
+    with pytest.raises(ValueError, match="serve_ckpt_poll_s"):
+        Config(serve_ckpt_poll_s=0)
+
+
+def test_config_role_override_beats_derivation():
+    from distributed_sgd_tpu.config import Config
+
+    assert Config().role == "dev"
+    assert Config(master_host="10.0.0.1", master_port=4000).role == "worker"
+    assert Config(master_host="10.0.0.1", master_port=4000,
+                  role_override="dev").role == "dev"
+
+
+# -- histogram quantiles (satellite) ----------------------------------------
+
+
+def test_histogram_quantiles_exact_within_reservoir():
+    from distributed_sgd_tpu.utils.metrics import Histogram
+
+    h = Histogram("q")
+    for v in range(1, 101):
+        h.record(float(v))
+    assert h.quantile(0.0) == 1.0
+    assert h.quantile(1.0) == 100.0
+    assert h.quantile(0.5) == pytest.approx(50.5)
+    assert h.quantile(0.95) == pytest.approx(95.05)
+    assert h.quantiles().keys() == {0.5, 0.95, 0.99}
+    assert np.isnan(Histogram("empty").quantile(0.5))
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_histogram_quantiles_estimate_beyond_reservoir():
+    from distributed_sgd_tpu.utils.metrics import Histogram
+
+    h = Histogram("big")
+    for v in range(10_000):  # uniform 0..9999, reservoir holds 512
+        h.record(float(v))
+    assert len(h._reservoir) == Histogram.RESERVOIR_SIZE
+    assert h.quantile(0.5) == pytest.approx(5000, rel=0.15)
+    assert h.quantile(0.99) == pytest.approx(9900, rel=0.05)
+
+
+def test_exporters_emit_quantiles():
+    m = Metrics(tags={"node": "n1"})
+    h = m.histogram("serve.predict.duration")
+    for v in range(1, 21):
+        h.record(float(v))
+    text = m.prometheus_text()
+    assert 'serve_predict_duration{node="n1",quantile="0.5"} 10.5' in text
+    assert 'quantile="0.99"' in text
+    lines = m.influx_lines(ts_ns=42)
+    assert "p50=10.5" in lines and "p95=" in lines and "p99=" in lines
