@@ -300,6 +300,14 @@ def baseline_epoch_seconds(idx, val, y, sample: int = 400) -> dict:
 
 
 def main() -> None:
+    if "--comms" in sys.argv:
+        # wire-codec microbench (gradient compression PR): bytes +
+        # encode/decode wall time per codec at dim=47,236 — its own stdout
+        # JSON line, leaving the headline epoch bench contract untouched
+        from benches import bench_comms
+
+        bench_comms.main()
+        return
     log("generating RCV1-scale synthetic data...")
     t0 = time.perf_counter()
     idx, val, y = gen_data(N_SAMPLES)

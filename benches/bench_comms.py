@@ -1,0 +1,123 @@
+"""Wire-codec microbench: bytes + encode/decode wall time per codec.
+
+The comms-bound paths ship f32 vectors at the RCV1 weight dimension
+(47,236): async gossip deltas (dense after L2 regularization) and sync
+fan-in gradient sums (support bounded by the batch's feature union).  This
+bench measures, per codec, the actual serialized wire bytes, the
+encode/decode wall time, and the reconstruction error, at the gossip shape
+and across a density sweep of fan-in-like vectors.
+
+Run: ``python -m benches.bench_comms`` (or ``python bench.py --comms``).
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+
+The headline field `gossip_reduction_topk_1pct` is the acceptance bar of
+the compression PR: >= 20x fewer wire bytes than dense f32 on the gossip
+path at k/dim = 1% (docs/COMPRESSION.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+DIM = 47_236  # Dataset.scala:16 — the RCV1 weight dimension
+REPS = 30
+DENSITIES = (1.0, 0.1, 0.01)  # gossip (dense) -> narrow fan-in supports
+TOPK_FRACTIONS = (0.001, 0.01, 0.05)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _gossip_vec(rng: np.random.Generator, density: float) -> np.ndarray:
+    x = rng.normal(size=DIM).astype(np.float32) * 1e-3
+    if density < 1.0:
+        x[rng.random(DIM) >= density] = 0.0
+    return x
+
+
+def _best(fn, reps: int = REPS) -> float:
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _measure(comp, x: np.ndarray) -> dict:
+    from distributed_sgd_tpu.rpc import codec
+
+    msg = comp.compress(x, dest="bench")  # warm (jit compile for topk)
+    out = codec.decode_grad(msg)
+    err = float(np.linalg.norm(out - x) / max(np.linalg.norm(x), 1e-12))
+    wire = msg.ByteSize()
+    enc_s = _best(lambda: comp.compress(x, dest="bench"))
+    dec_s = _best(lambda: codec.decode_grad(msg))
+    return {
+        "wire_bytes": wire,
+        "dense_bytes": 4 * DIM,
+        "reduction": round(4 * DIM / wire, 2),
+        "encode_us": round(enc_s * 1e6, 1),
+        "decode_us": round(dec_s * 1e6, 1),
+        "rel_l2_err_first_msg": round(err, 6),
+    }
+
+
+def _codecs():
+    from distributed_sgd_tpu.compress import (
+        NoneCompressor,
+        QInt8Compressor,
+        TopKCompressor,
+    )
+    from distributed_sgd_tpu.utils.metrics import Metrics
+
+    out = [("none", NoneCompressor(metrics=Metrics()))]
+    for f in TOPK_FRACTIONS:
+        out.append((f"topk_{f:g}", TopKCompressor(k=f, metrics=Metrics())))
+    out.append(("qint8", QInt8Compressor(metrics=Metrics())))
+    return out
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    result: dict = {"metric": "comms_codec_bench", "dim": DIM, "reps": REPS}
+
+    # gossip shape: dense delta, the dominant wire cost (ISSUE: O(peers x
+    # dim) bytes per async round)
+    gossip = _gossip_vec(rng, 1.0)
+    table: dict = {}
+    for name, comp in _codecs():
+        table[name] = _measure(comp, gossip)
+        log(f"gossip {name:>11}: {table[name]['wire_bytes']:>7} B "
+            f"({table[name]['reduction']:>7.2f}x)  "
+            f"enc {table[name]['encode_us']:>8.1f}us  "
+            f"dec {table[name]['decode_us']:>7.1f}us  "
+            f"err {table[name]['rel_l2_err_first_msg']}")
+    result["gossip"] = table
+    result["gossip_reduction_topk_1pct"] = table["topk_0.01"]["reduction"]
+
+    # density sweep: fan-in-like vectors where the existing dense-vs-sparse
+    # auto switch already helps — what compression adds on top
+    sweep: dict = {}
+    for density in DENSITIES:
+        x = _gossip_vec(rng, density)
+        row = {}
+        for name, comp in _codecs():
+            m = _measure(comp, x)
+            row[name] = {"wire_bytes": m["wire_bytes"],
+                         "reduction": m["reduction"]}
+        sweep[f"density_{density:g}"] = row
+        log(f"density {density:g}: " + "  ".join(
+            f"{n}={v['wire_bytes']}B" for n, v in row.items()))
+    result["density_sweep"] = sweep
+
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,36 @@
+"""Pluggable gradient compression (DSGD_COMPRESS; docs/COMPRESSION.md).
+
+Every gradient that crosses the wire — sync fan-in replies
+(core/worker.py Gradient), async delta gossip (core/worker.py _async_loop,
+parallel/hogwild.py), and the master-bound update stream — goes through a
+`Compressor`, which turns a dense f32 vector into a `GradUpdate` wire
+message and keeps the per-destination error-feedback state that makes the
+lossy codecs converge:
+
+- ``none``   identity; `make_compressor` returns None so the hot paths keep
+             today's `codec.encode_grad` calls byte-for-byte (the
+             `NoneCompressor` class exists for API-uniform benches/tests);
+- ``topk``   magnitude top-k sparsification (Deep Gradient Compression,
+             Lin et al.): ship the k largest-|x| coordinates, accumulate
+             the rest in a per-destination residual that rides a later
+             message — selection jit-compiled in ops/topk.py;
+- ``qint8``  stochastic int8 quantization with per-chunk scales (QSGD,
+             Alistarh et al.): full support, 4x fewer payload bytes,
+             unbiased codes; quantization error optionally fed back.
+
+Residuals and the summed-delta contract: peers merge gossip by commutative
+subtraction (core/worker.py _async_loop), and every message a compressor
+emits is still a plain weight-space delta — error feedback only moves WHEN
+a coordinate's mass ships, never what the receiving merge does with it, so
+the commutativity the async engines rely on is untouched.
+"""
+
+from distributed_sgd_tpu.compress.codecs import (  # noqa: F401
+    Compressor,
+    NoneCompressor,
+    QInt8Compressor,
+    TopKCompressor,
+    make_compressor,
+)
+
+COMPRESS_CHOICES = ("none", "topk", "qint8")

@@ -75,6 +75,15 @@ class Config:
     optimizer: str = "sgd"  # sgd (reference) | momentum | adam (sync engine)
     momentum: float = 0.9  # used by optimizer='momentum'
     steps_per_dispatch: int = 1  # async: k local steps per gossip dispatch
+    # gradient compression on the wire paths (compress/, docs/COMPRESSION.md):
+    # sync Gradient replies + async delta gossip.  'none' keeps the wire
+    # byte-identical to the uncompressed tree; 'topk' ships the compress_k
+    # largest-magnitude coordinates with error feedback; 'qint8' ships
+    # stochastically-rounded int8 with per-chunk scales.  In-mesh engines
+    # (XLA collectives, no wire) ignore these with a warning.
+    compress: str = "none"  # none | topk | qint8
+    compress_k: float = 0.01  # topk size: fraction of dim if < 1, count if >= 1
+    compress_ef: bool = True  # error-feedback residual accumulation
     # tensor parallelism: shard the blocked weight rows over F feature
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
@@ -103,6 +112,7 @@ class Config:
         # reachable through SyncEngine(kernel='pallas') for kernel work
         "kernel": ("mxu", "scalar"),
         "optimizer": ("sgd", "momentum", "adam"),
+        "compress": ("none", "topk", "qint8"),
     }
 
     def __post_init__(self):
@@ -118,6 +128,8 @@ class Config:
             raise ValueError("checkpoint_every must be >= 1")
         if self.steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        if self.compress_k <= 0:
+            raise ValueError("compress_k must be > 0 (fraction of dim or count)")
         if self.feature_shards < 1:
             raise ValueError("feature_shards must be >= 1")
         if self.feature_shards > 1 and self.use_async:
@@ -212,6 +224,9 @@ class Config:
             optimizer=_env("DSGD_OPTIMIZER", cls.optimizer, str),
             momentum=_env("DSGD_MOMENTUM", cls.momentum, float),
             steps_per_dispatch=_env("DSGD_STEPS_PER_DISPATCH", cls.steps_per_dispatch, int),
+            compress=_env("DSGD_COMPRESS", cls.compress, str),
+            compress_k=_env("DSGD_COMPRESS_K", cls.compress_k, float),
+            compress_ef=_env("DSGD_COMPRESS_EF", cls.compress_ef, bool),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
             role_override=_env("DSGD_ROLE", None, str),
             serve_port=_env("DSGD_SERVE_PORT", cls.serve_port, int),

@@ -36,6 +36,9 @@ class DevCluster:
         seed: int = 0,
         heartbeat_s: Optional[float] = None,
         steps_per_dispatch: int = 1,
+        compress: str = "none",
+        compress_k: float = 0.01,
+        compress_ef: bool = True,
     ):
         devs = list(devices if devices is not None else jax.devices())
         self.master = MasterNode(
@@ -49,6 +52,8 @@ class DevCluster:
                 host, port, host, self.master.port, train, model,
                 device=devs[i % len(devs)], seed=seed + i,
                 steps_per_dispatch=steps_per_dispatch,
+                compress=compress, compress_k=compress_k,
+                compress_ef=compress_ef,
             )
             self.workers.append(w)
         for w in self.workers:

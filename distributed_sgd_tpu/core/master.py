@@ -149,6 +149,17 @@ class MasterNode:
         # reference has none and a dead worker hangs the sync barrier)
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        # fit-session counter: each fit_sync stamps its GradientRequests
+        # with a fresh token so long-lived workers reset their sync-reply
+        # EF residuals between fits (GradientRequest.fit_token).  The base
+        # is a per-incarnation nonce: a RESTARTED master must not reuse a
+        # token its long-lived workers already saw, or the worker would
+        # skip the reset and leak the dead master's residual into the new
+        # fit (48-bit nonce + 15-bit sequence stays inside int64)
+        import random as _random
+
+        self._fit_token_base = _random.getrandbits(48) << 15
+        self._fit_seq = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -424,6 +435,8 @@ class MasterNode:
         result = FitResult(state=GradState(weights=w))
         test_newest_first: List[float] = []
         tracker = _FailureTracker(grad_retries + 1)
+        self._fit_seq += 1
+        fit_token = self._fit_token_base + self._fit_seq
 
         from distributed_sgd_tpu.checkpoint import opt_kind_tag
         from distributed_sgd_tpu.parallel.sync import resolve_optimizer
@@ -490,7 +503,8 @@ class MasterNode:
                     ids = shuffled[batch : batch + batch_size]
                     try:
                         fut = stub.Gradient.future(
-                            pb.GradientRequest(weights=wmsg, samples=ids.astype(np.int32)),
+                            pb.GradientRequest(weights=wmsg, samples=ids.astype(np.int32),
+                                               fit_token=fit_token),
                             timeout=grad_timeout_s,
                         )
                     except ValueError:  # channel closed under us
@@ -518,6 +532,12 @@ class MasterNode:
                             key[0], key[1], n, code)
                         self.unregister_worker(*key)
                     continue  # retry this batch window (survivors or re-split)
+                # receive-side wire accounting (send-side comms.* counters
+                # live in the workers' compressors; this is what the MASTER
+                # observed, meaningful when the processes don't share a
+                # metrics registry)
+                self.metrics.counter("master.sync.grad.bytes").increment(
+                    sum(reply.ByteSize() for _, reply in ok))
                 grads = [codec.decode_grad(reply) for _, reply in ok]
                 grad = np.mean(grads, axis=0)  # Vec.mean (Master.scala:194)
                 if opt is None:
@@ -901,5 +921,9 @@ class _MasterServicer:
         return pb.Ack()
 
     def UpdateGrad(self, request, context):  # noqa: N802
+        # receive-side wire accounting for the gossip stream (send-side
+        # comms.* counters live in the workers' compressors)
+        self.m.metrics.counter("master.async.grad.bytes").increment(
+            request.ByteSize())
         self.m._update_grad(codec.decode_grad(request), n_steps=request.n_steps or 1)
         return pb.Ack()

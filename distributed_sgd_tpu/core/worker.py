@@ -62,6 +62,9 @@ class WorkerNode:
         metrics: Optional[metrics_mod.Metrics] = None,
         steps_per_dispatch: int = 1,
         max_inflight_gossip: int = 64,
+        compress: str = "none",
+        compress_k: float = 0.01,
+        compress_ef: bool = True,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -69,6 +72,22 @@ class WorkerNode:
         self.model = model
         self.device = device if device is not None else jax.devices()[0]
         self.seed = seed
+        # wire-path gradient compression (compress/, docs/COMPRESSION.md):
+        # None for the default codec, keeping every send below byte-identical
+        # to the uncompressed tree.  Residuals are per destination inside the
+        # compressor, so sync replies ("sync:master") and each gossip peer
+        # accumulate independently.
+        from distributed_sgd_tpu.compress import make_compressor
+
+        self._compressor = make_compressor(
+            compress, k=compress_k, error_feedback=compress_ef,
+            seed=seed + port, metrics=self.metrics)
+        # sync-reply EF retry guard: (weights bytes, residual snapshot) of
+        # the last Gradient request, plus the fit-session token last seen —
+        # see encode_sync_grad
+        self._sync_ef_guard: Tuple[Optional[bytes], Optional[np.ndarray]] = (
+            None, None)
+        self._sync_fit_token = 0
         # k local SGD steps per compiled dispatch; the summed delta is
         # gossiped every k steps (deltas commute — same amortization as
         # parallel/hogwild.py, GradUpdate.n_steps carries k on the wire).
@@ -179,6 +198,15 @@ class WorkerNode:
         with self._peers_lock:
             self._peers.pop((host, port), None)
             sender = self._gossip.pop((host, port), None)
+            if self._compressor is not None:
+                # a rejoining peer starts from a zero residual (the same
+                # state as any destination joining mid-stream), and departed
+                # peers must not pin dim-sized residual arrays forever.  An
+                # async-loop compress in flight for this dest may re-create
+                # the entry after this drop; the loop's post-fan-out sweep
+                # (under this same lock) re-drops any dest that lost
+                # membership mid-fan-out
+                self._compressor.residual_drop(("peer", (host, port)))
         if sender is not None:
             sender.close()
 
@@ -231,6 +259,44 @@ class WorkerNode:
         self.metrics.counter("slave.sync.backward").increment()
         return np.asarray(g)
 
+    def encode_sync_grad(self, g: np.ndarray, weights_bytes: bytes,
+                         fit_token: int = 0):
+        """Compressed Gradient reply with at-most-once residual drain.
+
+        `compress` removes the shipped top-k mass from the EF residual at
+        encode time, but the sync master DISCARDS every ok reply in a batch
+        window when a sibling worker fails and retries the whole window
+        (core/master.py fit_sync) — without compensation each retry would
+        permanently lose this worker's largest-magnitude coordinates.  A
+        retry is recognizable here: it carries byte-identical weights (the
+        master only advances w after a fully-successful window), so on a
+        repeat of the previous request's weights the pre-drain residual is
+        restored before re-encoding.  (Identical weights across *different*
+        windows would need an exactly-zero update — in which case the
+        restored and current residuals coincide and the rollback is a
+        no-op.)
+
+        `fit_token` scopes the residual to ONE fit: the master stamps each
+        fit_sync's requests with a fresh token, and a token change drops
+        the residual + guard here, so one fit's unsent mass (a gradient of
+        the abandoned trajectory) never leaks into the next fit's first
+        windows.  0 = an older master without session tracking: behave as
+        before (residual carried, bounded by one window's unsent mass).
+        """
+        if fit_token and fit_token != self._sync_fit_token:
+            self._sync_fit_token = fit_token
+            self._compressor.residual_drop("sync:master")
+            self._sync_ef_guard = (None, None)
+        prev_w, prev_res = self._sync_ef_guard
+        if prev_w is not None and prev_w == weights_bytes:
+            self._compressor.residual_restore("sync:master", prev_res)
+        else:
+            self._sync_ef_guard = (
+                weights_bytes,
+                self._compressor.residual_snapshot("sync:master"),
+            )
+        return self._compressor.compress(g, dest="sync:master")
+
     def compute_forward(self, w: np.ndarray, ids: np.ndarray):
         """Forward RPC body (Slave.scala:129-140) -> (predictions, margins).
 
@@ -256,6 +322,14 @@ class WorkerNode:
             self.log.info("StartAsync re-issued: replacing the running async loop")
             self._running_async.clear()
             self._async_thread.join()
+        if self._compressor is not None:
+            # error-feedback residuals belong to the trajectory that
+            # accumulated them: a StartAsync begins (or replaces) a session
+            # from fresh weights, and shipping the abandoned trajectory's
+            # unsent mass into it would inject stale gradients — same for
+            # the sync-reply residual of any fit that ran before this one
+            self._compressor.reset()
+            self._sync_ef_guard = (None, None)
         with self._w_lock:
             self._w = jax.device_put(jnp.asarray(w0, dtype=jnp.float32), self.device)
         self._assignment = jax.device_put(
@@ -338,13 +412,48 @@ class WorkerNode:
             with self._w_lock:
                 self._w = self._apply(self._w, delta)
             self.metrics.counter("slave.async.batch").increment(ksteps)
-            msg = codec.encode_grad(np.asarray(delta))
-            msg.n_steps = ksteps
-            with self._peers_lock:
-                senders = list(self._gossip.values())
-            for sender in senders:  # fire-and-forget (Slave.scala:103-105),
-                sender.send(msg)    # bounded in-flight, drop-oldest
-            self._master_gossip.send(msg)
+            delta_np = np.asarray(delta)
+            if self._compressor is None:
+                msg = codec.encode_grad(delta_np)
+                msg.n_steps = ksteps
+                with self._peers_lock:
+                    senders = list(self._gossip.values())
+                for sender in senders:  # fire-and-forget (Slave.scala:103-105),
+                    sender.send(msg)    # bounded in-flight, drop-oldest
+                self._master_gossip.send(msg)
+            else:
+                # per-destination encode: each peer (and the master) has its
+                # own error-feedback residual, so the k coordinates shipped
+                # can differ by destination.  Every message stays a plain
+                # weight-space delta, so the receiving merges keep the
+                # summed-delta commutativity contract above — EF only defers
+                # WHEN a coordinate's mass arrives, bounded by the residual.
+                # Note on transport drops: like the uncompressed wire, a
+                # gossip message the bounded sender cancels is simply lost
+                # (fire-and-forget permits it) — EF retransmits only what
+                # SELECTION dropped, never what the transport dropped; the
+                # loss stays bounded by one message per cancel, exactly as
+                # in the uncompressed mode (docs/COMPRESSION.md).
+                # Compress OUTSIDE _peers_lock (the first call jit-compiles
+                # the selection — holding the lock through that would stall
+                # Register/UnregisterSlave servicers); the post-loop sweep
+                # below closes the race where a concurrent remove_peer's
+                # residual_drop interleaves with an in-flight compress and
+                # the dropped entry gets silently re-created.
+                with self._peers_lock:
+                    senders_c = list(self._gossip.items())
+                for peer_key, sender in senders_c:
+                    msg = self._compressor.compress(
+                        delta_np, dest=("peer", peer_key))
+                    msg.n_steps = ksteps
+                    sender.send(msg)
+                msg = self._compressor.compress(delta_np, dest="master")
+                msg.n_steps = ksteps
+                self._master_gossip.send(msg)
+                with self._peers_lock:
+                    for peer_key, _ in senders_c:
+                        if peer_key not in self._gossip:
+                            self._compressor.residual_drop(("peer", peer_key))
 
 
 class _WorkerServicer:
@@ -376,6 +485,12 @@ class _WorkerServicer:
         w = codec.decode_tensor(request.weights)
         ids = np.fromiter(request.samples, dtype=np.int64)
         g = self.w.compute_gradient(w, ids)
+        # sync fan-in reply: compressed when configured (EF residual keyed
+        # to the one sync destination — this worker answers one master),
+        # with the retry-rollback + fit-session guards of encode_sync_grad
+        if self.w._compressor is not None:
+            return self.w.encode_sync_grad(g, request.weights.data,
+                                           request.fit_token)
         return codec.encode_grad(g)
 
     def StartAsync(self, request, context):  # noqa: N802

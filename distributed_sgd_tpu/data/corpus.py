@@ -81,12 +81,19 @@ def _template_bodies(
         row_val /= max(float(np.linalg.norm(row_val)), 1e-12)
         # drop entries the %.6f text format would round to 0.000000 (a
         # floored ubiquitous feature over a large row norm): real RCV1
-        # files carry no zero-valued tokens, and the planted margin must
-        # see exactly the values the parser will read back
-        keep = row_val >= 5e-7
+        # files carry no zero-valued tokens, and the floor must sit at a
+        # value %.6f keeps nonzero — 5e-7 itself formats as 0.000000
+        # (round-half-even), so the floor and the degenerate fallback are
+        # both 1e-6, the smallest value the format preserves
+        keep = row_val >= 1e-6
         row_idx, row_val = row_idx[keep], row_val[keep]
         if len(row_idx) == 0:  # degenerate all-dropped row: keep one token
-            row_idx, row_val = np.array([1], np.int32), np.array([5e-7], np.float64)
+            row_idx, row_val = np.array([1], np.int32), np.array([1e-6], np.float64)
+        # the planted margin must see exactly the values the parser will
+        # read back: round to the %.6f wire precision BEFORE the dot, or a
+        # margin near the median could flip its label relative to the file
+        # contents even at noise=0
+        row_val = np.round(row_val, 6)
         margins[r] = float(np.dot(row_val, w_true[row_idx]))
         bodies.append(
             " ".join(f"{c + 1}:{v:.6f}" for c, v in zip(row_idx, row_val))

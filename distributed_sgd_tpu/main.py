@@ -148,6 +148,14 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     )
     mesh = make_mesh(n)
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
+    if cfg.compress != "none" and not (cfg.use_async and cfg.async_mode == "gossip"):
+        # the sync / local-SGD / feature-sharded mesh engines exchange
+        # gradients through XLA collectives — there is no wire to compress
+        # (docs/COMPRESSION.md "when NOT to compress"); only the gossip
+        # engine and the rpc topology honor DSGD_COMPRESS
+        log.warning(
+            "DSGD_COMPRESS=%s ignored: in-mesh engines have no wire path "
+            "(use engine=rpc or async_mode=gossip)", cfg.compress)
     log.info(
         "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
         n, virtual, cfg.kernel, cfg.model, cfg.use_async,
@@ -183,6 +191,8 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             leaky_loss=cfg.leaky_loss, seed=cfg.seed, checkpointer=ckpt,
             steps_per_dispatch=cfg.steps_per_dispatch,
             optimizer=cfg.optimizer, momentum=cfg.momentum,
+            compress=cfg.compress, compress_k=cfg.compress_k,
+            compress_ef=cfg.compress_ef,
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion,
                       initial_weights=_restore_weights(ckpt))
@@ -219,7 +229,9 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
 
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
     with DevCluster(model, train, test, n_workers=cfg.node_count, seed=cfg.seed,
-                    steps_per_dispatch=cfg.steps_per_dispatch) as c:
+                    steps_per_dispatch=cfg.steps_per_dispatch,
+                    compress=cfg.compress, compress_k=cfg.compress_k,
+                    compress_ef=cfg.compress_ef) as c:
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
@@ -362,6 +374,8 @@ def _run_role(cfg: Config, role: str) -> None:
         worker = WorkerNode(
             cfg.host, cfg.port, cfg.master_host, cfg.master_port, train, model,
             seed=cfg.seed, steps_per_dispatch=cfg.steps_per_dispatch,
+            compress=cfg.compress, compress_k=cfg.compress_k,
+            compress_ef=cfg.compress_ef,
         ).start()
         worker.await_termination()
 

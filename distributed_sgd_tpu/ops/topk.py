@@ -1,0 +1,55 @@
+"""Jit-compiled magnitude top-k selection (gradient compression support).
+
+One XLA program per k (jit retraces per input shape as usual): |x| through
+``lax.top_k``, support sorted ascending so the wire's coordinate list (and
+the decode scatter) walk memory forward.  Used by compress/ to pick the
+sparsification support on whatever device the delta already lives on —
+at RCV1 scale (47,236 dims) the selection is a single fused reduction
+instead of a host-side argpartition over a pulled copy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_SELECT_CACHE: Dict[int, callable] = {}
+
+
+def _select_fn(k: int):
+    if k not in _SELECT_CACHE:
+
+        def sel(x):
+            _, idx = jax.lax.top_k(jnp.abs(x), k)
+            idx = jnp.sort(idx)  # ascending support for the wire + scatter
+            return idx, x[idx]
+
+        _SELECT_CACHE[k] = jax.jit(sel)
+    return _SELECT_CACHE[k]
+
+
+def resolve_k(k: float, dim: int) -> int:
+    """Config's DSGD_COMPRESS_K: a fraction of dim when < 1 (the paper-style
+    k/dim density), an absolute coordinate count when >= 1; clamped to
+    [1, dim]."""
+    if k <= 0:
+        raise ValueError(f"top-k needs k > 0, got {k}")
+    n = int(round(k * dim)) if k < 1.0 else int(k)
+    return max(1, min(n, dim))
+
+
+def topk_magnitude(x, k: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(indices, values) of the k largest-|x| coordinates, indices ascending.
+
+    Accepts a numpy or jax array; returns host numpy (int32, float32) ready
+    for the wire codec.
+    """
+    n = int(x.shape[0])
+    idx, vals = _select_fn(min(max(1, int(k)), n))(jnp.asarray(x, jnp.float32))
+    return (
+        np.asarray(idx, dtype=np.int32),
+        np.asarray(vals, dtype=np.float32),
+    )

@@ -79,10 +79,14 @@ class _Worker:
         steps_per_dispatch: int = 1,
         optimizer=None,
         momentum: float = 0.9,
+        compressor=None,
     ):
         self.wid = wid
         self.device = device
         self.metrics = metrics
+        # wire-path gradient compression (compress/): this worker's OWN
+        # instance — residuals are per (worker, destination), never shared
+        self._compressor = compressor
         self.k = max(1, int(steps_per_dispatch))
         self.inbox: "queue.Queue[np.ndarray]" = queue.Queue(maxsize=max_inbox)
         self._lock = threading.Lock()
@@ -236,10 +240,30 @@ class _Worker:
                 self.w = self._apply(self.w, delta)
             self.metrics.counter("slave.async.batch").increment(self.k)
             delta_np = np.asarray(delta)  # host hop = the wire serialization
-            for peer in self._peers:
-                peer.push_delta(delta_np)
-            if self._master is not None:
-                self._master._update_grad(delta_np, n_steps=self.k)
+            if self._compressor is None:
+                for peer in self._peers:
+                    peer.push_delta(delta_np)
+                if self._master is not None:
+                    self._master._update_grad(delta_np, n_steps=self.k)
+            else:
+                # the in-process engine models the wire faithfully: each
+                # destination receives the DECODED lossy delta its own
+                # encode would have produced (per-dest EF residuals), and
+                # the real proto message is built so comms.* accounting
+                # measures actual serialized bytes.  Local weights above
+                # already absorbed the full delta; what a destination
+                # doesn't get now, its residual ships later — merges stay
+                # the commutative subtractions Hogwild needs.
+                from distributed_sgd_tpu.rpc import codec as _codec  # cached after first loop
+
+                for peer in self._peers:
+                    msg = self._compressor.compress(
+                        delta_np, dest=("peer", peer.wid))
+                    peer.push_delta(_codec.decode_grad(msg))
+                if self._master is not None:
+                    msg = self._compressor.compress(delta_np, dest="master")
+                    self._master._update_grad(
+                        _codec.decode_grad(msg), n_steps=self.k)
             self._t += self.k
 
 
@@ -262,6 +286,9 @@ class HogwildEngine:
         checkpointer=None,
         optimizer=None,
         momentum: float = 0.9,
+        compress: str = "none",
+        compress_k: float = 0.01,
+        compress_ef: bool = True,
     ):
         """steps_per_dispatch=k amortizes host dispatch: each worker runs k
         local SGD steps in one compiled program and gossips the summed
@@ -272,7 +299,14 @@ class HogwildEngine:
 
         `optimizer` (None/'sgd' | 'momentum' | 'adam' | optax transform)
         shapes each worker's LOCAL steps; state never travels — the wire
-        still carries weight-space deltas, so peer merges stay commutative."""
+        still carries weight-space deltas, so peer merges stay commutative.
+
+        `compress`/`compress_k`/`compress_ef` (DSGD_COMPRESS*) put the
+        delta gossip through the compress/ wire codecs: each worker gets
+        its own compressor with per-destination error-feedback residuals,
+        and every destination receives the decoded lossy delta its encode
+        produced — the in-process analogue of the RPC topology's
+        compressed UpdateGrad stream (docs/COMPRESSION.md)."""
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
         if steps_per_dispatch < 1:
@@ -288,6 +322,9 @@ class HogwildEngine:
         self.checkpointer = checkpointer  # persists best weights (LossChecker)
         self.optimizer = optimizer
         self.momentum = momentum
+        self.compress = compress
+        self.compress_k = compress_k
+        self.compress_ef = compress_ef
         self.seed = seed
         self.metrics = metrics or metrics_mod.global_metrics()
         devs = list(devices if devices is not None else jax.devices())
@@ -360,6 +397,8 @@ class HogwildEngine:
 
         # contiguous shard assignment, as the reference's vanilla split
         splits = vanilla_split(n, self.n_workers)
+        from distributed_sgd_tpu.compress import make_compressor
+
         workers = [
             _Worker(
                 i,
@@ -373,6 +412,10 @@ class HogwildEngine:
                 steps_per_dispatch=self.steps_per_dispatch,
                 optimizer=self.optimizer,
                 momentum=self.momentum,
+                compressor=make_compressor(
+                    self.compress, k=self.compress_k,
+                    error_feedback=self.compress_ef, seed=self.seed + i,
+                    metrics=self.metrics),
             )
             for i in range(self.n_workers)
         ]

@@ -5,6 +5,13 @@ maps into `math.Vec` (core/package.scala:11-13, proto.proto:8-11).  Dense
 f32 vectors travel as raw little-endian bytes; small-support deltas can
 travel as coordinate lists, chosen automatically by `encode_grad` when the
 sparse form is smaller on the wire.
+
+Lossy compressed forms (CompressedGrad: top-k coordinate lists, int8
+quantization with per-chunk scales) live here as STATELESS pack/unpack
+functions; the policy and state around them — which codec, error-feedback
+residuals, comms accounting — is the compress/ subsystem's job
+(docs/COMPRESSION.md).  `decode_grad` understands every arm, so receivers
+never need to know what the sender negotiated.
 """
 
 from __future__ import annotations
@@ -12,6 +19,9 @@ from __future__ import annotations
 import numpy as np
 
 from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+QINT8_CHUNK = 512  # default elements per quantization scale chunk
+_QINT8_LEVELS = 127.0  # int8 code range is [-127, 127]; -128 unused
 
 
 def encode_tensor(x: np.ndarray) -> pb.Tensor:
@@ -40,12 +50,76 @@ def encode_grad(x: np.ndarray, sparse_threshold: float = 0.25) -> pb.GradUpdate:
     return pb.GradUpdate(dense=encode_tensor(x))
 
 
+def encode_topk(indices: np.ndarray, values: np.ndarray, size: int) -> pb.GradUpdate:
+    """Top-k support as a CompressedGrad (compress/ picks the support)."""
+    return pb.GradUpdate(
+        compressed=pb.CompressedGrad(
+            codec="topk",
+            size=int(size),
+            indices=np.asarray(indices, dtype=np.int32),
+            values=np.asarray(values, dtype=np.float32),
+        )
+    )
+
+
+def quantize_qint8(
+    x: np.ndarray, rng: np.random.Generator, chunk: int = QINT8_CHUNK
+) -> pb.GradUpdate:
+    """Stochastic int8 quantization with one f32 scale per `chunk` elements.
+
+    Per chunk c: scale_c = max|x_c| / 127 and each element rounds to
+    floor(x/scale + u), u ~ U[0,1) — unbiased (E[decode] = x) with
+    per-element error < scale_c.  An all-zero chunk gets scale 0 and codes 0.
+    """
+    x = np.asarray(x, dtype=np.float32)
+    n = len(x)
+    chunk = max(1, int(chunk))
+    n_chunks = -(-n // chunk) if n else 0
+    pad = n_chunks * chunk - n
+    xp = np.pad(x, (0, pad)).reshape(n_chunks, chunk) if n else x.reshape(0, chunk)
+    scales = np.abs(xp).max(axis=1) / _QINT8_LEVELS
+    safe = np.where(scales > 0, scales, 1.0)[:, None]
+    q = np.floor(xp / safe + rng.random(xp.shape, dtype=np.float32))
+    codes = np.clip(q, -_QINT8_LEVELS, _QINT8_LEVELS).astype(np.int8)
+    codes[scales == 0] = 0
+    return pb.GradUpdate(
+        compressed=pb.CompressedGrad(
+            codec="qint8",
+            size=n,
+            data=codes.reshape(-1)[:n].tobytes(),
+            scales=scales.astype(np.float32),
+            chunk=chunk,
+        )
+    )
+
+
+def _scatter(indices, values, size: int) -> np.ndarray:
+    """Coordinate list -> dense f32 via bulk conversion (the repeated-field
+    containers support the sequence protocol, and np.asarray over them is
+    ~10x fromiter on 47k-dim gossip decodes)."""
+    out = np.zeros(size, dtype=np.float32)
+    if len(indices):
+        out[np.asarray(indices, dtype=np.int64)] = np.asarray(
+            values, dtype=np.float32
+        )
+    return out
+
+
+def decode_compressed(c: pb.CompressedGrad) -> np.ndarray:
+    if c.codec == "topk":
+        return _scatter(c.indices, c.values, c.size)
+    if c.codec == "qint8":
+        codes = np.frombuffer(c.data, dtype=np.int8, count=c.size).astype(np.float32)
+        chunk = max(1, c.chunk or QINT8_CHUNK)
+        scales = np.asarray(c.scales, dtype=np.float32)
+        return codes * np.repeat(scales, chunk)[: c.size]
+    raise ValueError(f"unknown CompressedGrad codec {c.codec!r}")
+
+
 def decode_grad(g: pb.GradUpdate) -> np.ndarray:
-    if g.WhichOneof("grad") == "sparse":
-        out = np.zeros(g.sparse.size, dtype=np.float32)
-        if len(g.sparse.indices):
-            out[np.fromiter(g.sparse.indices, dtype=np.int64)] = np.fromiter(
-                g.sparse.values, dtype=np.float32
-            )
-        return out
+    which = g.WhichOneof("grad")
+    if which == "sparse":
+        return _scatter(g.sparse.indices, g.sparse.values, g.sparse.size)
+    if which == "compressed":
+        return decode_compressed(g.compressed)
     return decode_tensor(g.dense)

@@ -199,6 +199,29 @@ class Metrics:
         return "\n".join(lines) + ("\n" if lines else "")
 
 
+# -- comms accounting (gradient compression; docs/COMPRESSION.md) ------------
+#
+# Instrument names shared by every wire encoder (compress/ codecs, and the
+# receive-side counters in core/master.py).  Both exporters emit them like
+# any other instrument; they exist as constants so dashboards, tests, and
+# the bench (benches/bench_comms.py) agree on spelling.
+COMMS_BYTES_ON_WIRE = "comms.bytes_on_wire"        # counter: serialized bytes sent
+COMMS_BYTES_DENSE = "comms.bytes_dense_equiv"      # counter: 4*dim raw-f32 baseline
+COMMS_RATIO = "comms.compression_ratio"            # histogram: dense/wire per message
+COMMS_RESIDUAL_NORM = "comms.residual_norm"        # histogram: ||EF residual||2 per send
+
+
+def record_wire(metrics: "Metrics", wire_bytes: int, dense_bytes: int) -> None:
+    """Account one encoded gradient message: actual serialized size vs the
+    raw dense-f32 bytes the same vector would have cost, plus the per-message
+    compression ratio.  Called on the SEND side only, so a dev-mode cluster
+    (sender and receiver sharing the global registry) never double-counts."""
+    metrics.counter(COMMS_BYTES_ON_WIRE).increment(int(wire_bytes))
+    metrics.counter(COMMS_BYTES_DENSE).increment(int(dense_bytes))
+    if wire_bytes > 0:
+        metrics.histogram(COMMS_RATIO).record(dense_bytes / wire_bytes)
+
+
 _GLOBAL = Metrics()
 
 
