@@ -308,6 +308,16 @@ def main() -> None:
 
         bench_comms.main()
         return
+    if "--rpc" in sys.argv:
+        # pipelined sync-engine wire bench (docs/SYNC_PIPELINE.md):
+        # broadcast bytes + rounds per epoch on a 2-worker loopback RPC
+        # cluster, default vs DSGD_DELTA_BROADCAST=1 + DSGD_LOCAL_STEPS=4.
+        # --smoke is the CI-sized fast mode: tiny corpus, asserts the
+        # delta transport reconstructs the dense path's weights exactly
+        from benches import bench_rpc_sync
+
+        bench_rpc_sync.main(smoke="--smoke" in sys.argv)
+        return
     log("generating RCV1-scale synthetic data...")
     t0 = time.perf_counter()
     idx, val, y = gen_data(N_SAMPLES)

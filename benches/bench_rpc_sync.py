@@ -1,0 +1,229 @@
+"""RPC sync-path wire bench: broadcast bytes + rounds per epoch, with and
+without the pipelined sync engine (docs/SYNC_PIPELINE.md).
+
+The acceptance bar of the pipelined-sync PR: on a 2-worker RPC cluster
+(real loopback gRPC, the same topology as core/cluster.py dev mode) with
+DSGD_DELTA_BROADCAST=1 + DSGD_LOCAL_STEPS=4, master->worker broadcast
+bytes per epoch drop >= 5x and sync rounds per epoch drop >= 4x vs the
+default path, with final loss within 2% of the default (the convergence-
+parity gate style of docs/COMPRESSION.md).
+
+Three runs, one fresh cluster each, counters diffed from the global
+registry (utils/metrics.py master.sync.*):
+
+- ``default``   — knobs off: the seed's per-window dense broadcast;
+- ``delta_k1``  — DSGD_DELTA_BROADCAST only: transport is exact
+                  (WeightDelta ships absolute values), so the final
+                  weights must EQUAL the default run's bit-for-bit —
+                  asserted in --smoke (to 1e-6, observed 0);
+- ``pipelined`` — delta broadcast + K=4 local steps: the headline.
+
+Run: ``python bench.py --rpc`` (or ``--rpc --smoke`` for the CI-sized
+corpus).  Prints exactly ONE JSON line on stdout; diagnostics go to
+stderr.  Results are gated round-over-round through benches/regress.py
+(``*_bytes`` gates lower-is-better), so a future PR that silently
+regresses broadcast bytes fails the gate.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# full mode: RCV1's feature dimension and row density at a corpus size a
+# CPU run finishes in minutes.  n is a multiple of 160 so each worker's
+# partition (0.8 * n / 2) divides evenly by batch*K and the rounds ratio
+# is exactly K (a ragged tail would pay one extra short round both ways)
+FULL = dict(n=5120, n_features=47_236, nnz=76, batch=16, epochs=8, lr=0.5)
+SMOKE = dict(n=640, n_features=4096, nnz=8, batch=16, epochs=1, lr=0.5)
+K = 4
+N_WORKERS = 2
+# convergence-parity bar, the exact gate style of the compression PR
+# (tests/test_compress.py::_assert_within_2pct / docs/COMPRESSION.md):
+# final train loss within 2% relative of the default path, with a 0.02
+# absolute floor — near a zero hinge loss the relative bound is
+# ill-defined, and 0.02 is 2% of the loss at w = 0
+PARITY_REL = 1.02
+PARITY_ABS = 0.02
+
+_COUNTERS = (
+    "master.sync.rounds",
+    "master.sync.bcast.bytes",
+    "master.sync.bcast.full",
+    "master.sync.bcast.delta",
+    "master.sync.bcast.cached",
+    "master.sync.bcast.stale",
+    "master.sync.grad.bytes",
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _snapshot():
+    from distributed_sgd_tpu.utils import metrics as mm
+
+    g = mm.global_metrics()
+    return {name: g.counter(name).value for name in _COUNTERS}
+
+
+def _build(cfg: dict):
+    from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+    from distributed_sgd_tpu.data.synthetic import rcv1_like
+    from distributed_sgd_tpu.models.linear import make_model
+
+    data = rcv1_like(cfg["n"], n_features=cfg["n_features"], nnz=cfg["nnz"],
+                     seed=7, idf_values=True)
+    train, test = train_test_split(data)
+    ds = dim_sparsity(train)
+    make = lambda: make_model("hinge", 1e-5, train.n_features, dim_sparsity=ds)
+    return train, test, make
+
+
+def _run(train, test, make_model_fn, cfg: dict, *, delta: bool, k: int) -> dict:
+    """One fit_sync on a fresh 2-worker loopback cluster; returns the
+    counter deltas, per-epoch rates, wall time, and final state."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+
+    before = _snapshot()
+    t0 = time.perf_counter()
+    with DevCluster(make_model_fn(), train, test, n_workers=N_WORKERS,
+                    seed=0) as c:
+        res = c.master.fit_sync(
+            max_epochs=cfg["epochs"], batch_size=cfg["batch"],
+            learning_rate=cfg["lr"], local_steps=k, delta_broadcast=delta,
+        )
+    wall_s = time.perf_counter() - t0
+    after = _snapshot()
+    d = {name: after[name] - before[name] for name in _COUNTERS}
+    epochs = max(1, res.epochs_run)
+    return {
+        "counters": d,
+        "rounds_per_epoch": d["master.sync.rounds"] / epochs,
+        "bcast_bytes_per_epoch": d["master.sync.bcast.bytes"] / epochs,
+        "grad_bytes_per_epoch": d["master.sync.grad.bytes"] / epochs,
+        "final_loss": float(res.losses[-1]),
+        "final_test_loss": float(res.test_losses[-1]),
+        "weights": np.asarray(res.state.weights),
+        "wall_s": wall_s,
+    }
+
+
+def run_bench(smoke: bool = False) -> dict:
+    cfg = SMOKE if smoke else FULL
+    label = "smoke" if smoke else "full"
+    log(f"rpc sync bench ({label}): n={cfg['n']} dim={cfg['n_features']} "
+        f"nnz={cfg['nnz']} batch={cfg['batch']} epochs={cfg['epochs']} "
+        f"workers={N_WORKERS} K={K}")
+    train, test, make = _build(cfg)
+
+    dense = _run(train, test, make, cfg, delta=False, k=1)
+    log(f"default : rounds/epoch={dense['rounds_per_epoch']:.0f} "
+        f"bcast={dense['bcast_bytes_per_epoch']/1e3:.1f} KB/epoch "
+        f"test_loss={dense['final_test_loss']:.6f} ({dense['wall_s']:.1f}s)")
+
+    delta_k1 = _run(train, test, make, cfg, delta=True, k=1)
+    drift = float(np.max(np.abs(delta_k1["weights"] - dense["weights"])))
+    log(f"delta_k1: bcast={delta_k1['bcast_bytes_per_epoch']/1e3:.1f} KB/epoch "
+        f"max|w - w_dense|={drift:.2e} (transport must be exact)")
+    if smoke:
+        # CI gate: the versioned sparse transport reconstructs the dense
+        # path's weights exactly (absolute-value deltas; observed drift 0)
+        assert drift <= 1e-6, (
+            f"delta-broadcast weights drifted {drift} from the dense path "
+            f"at K=1 — the versioned transport must be exact")
+        per_round = delta_k1["counters"]["master.sync.bcast.bytes"] / max(
+            1, delta_k1["counters"]["master.sync.rounds"])
+        log(f"smoke: delta-path broadcast bytes/round = {per_round:.0f} "
+            f"(dense path: "
+            f"{dense['counters']['master.sync.bcast.bytes'] / max(1, dense['counters']['master.sync.rounds']):.0f})")
+
+    piped = _run(train, test, make, cfg, delta=True, k=K)
+    log(f"pipelined (K={K}): rounds/epoch={piped['rounds_per_epoch']:.0f} "
+        f"bcast={piped['bcast_bytes_per_epoch']/1e3:.1f} KB/epoch "
+        f"test_loss={piped['final_test_loss']:.6f} ({piped['wall_s']:.1f}s)")
+
+    bcast_reduction = (dense["bcast_bytes_per_epoch"]
+                       / max(1.0, piped["bcast_bytes_per_epoch"]))
+    rounds_reduction = (dense["rounds_per_epoch"]
+                        / max(1.0, piped["rounds_per_epoch"]))
+    parity_bound = max(PARITY_REL * dense["final_loss"],
+                       dense["final_loss"] + PARITY_ABS)
+    parity_ok = piped["final_loss"] <= parity_bound
+    if smoke:
+        # CI gate: K-step windows must not break convergence
+        assert parity_ok, (
+            f"pipelined final loss {piped['final_loss']:.6f} exceeds the "
+            f"parity bound {parity_bound:.6f} (default "
+            f"{dense['final_loss']:.6f})")
+    sends = piped["counters"]
+    hits = (sends["master.sync.bcast.delta"]
+            + sends["master.sync.bcast.cached"])
+    total_sends = hits + sends["master.sync.bcast.full"]
+    log(f"reductions: bcast bytes {bcast_reduction:.1f}x, rounds "
+        f"{rounds_reduction:.1f}x; delta-hit-rate {hits}/{total_sends}; "
+        f"loss parity {'OK' if parity_ok else 'FAIL'} "
+        f"({piped['final_loss']:.6f} vs bound {parity_bound:.6f}; "
+        f"bar: >=5x bytes, >=4x rounds, loss <= max(1.02*base, base+0.02))")
+
+    return {
+        "metric": f"rpc_sync_pipeline_{label}",
+        # headline, gated: the pipelined path's broadcast bytes must never
+        # silently regress (direction: *_bytes gates lower-is-better)
+        "value": round(piped["bcast_bytes_per_epoch"], 1),
+        "unit": "bytes/epoch",
+        "pipelined_bcast_bytes": round(piped["bcast_bytes_per_epoch"], 1),
+        "pipelined_grad_bytes": round(piped["grad_bytes_per_epoch"], 1),
+        "default_bcast_bytes": round(dense["bcast_bytes_per_epoch"], 1),
+        "delta_k1_bcast_bytes": round(delta_k1["bcast_bytes_per_epoch"], 1),
+        "bcast_reduction_x": round(bcast_reduction, 2),
+        "rounds_reduction_x": round(rounds_reduction, 2),
+        "rounds_per_epoch_default": dense["rounds_per_epoch"],
+        "rounds_per_epoch_pipelined": piped["rounds_per_epoch"],
+        "delta_hit_sends": hits,
+        "full_sends": sends["master.sync.bcast.full"],
+        "delta_k1_max_drift": drift,
+        "final_loss": round(piped["final_loss"], 6),
+        "default_final_loss_info": round(dense["final_loss"], 6),
+        "test_loss_info": round(piped["final_test_loss"], 6),
+        "default_test_loss_info": round(dense["final_test_loss"], 6),
+        "loss_parity_ok": int(parity_ok),
+        "loss_parity_bound_info": round(parity_bound, 6),
+        "local_steps": K,
+        "n_workers": N_WORKERS,
+        **{k_: v for k_, v in cfg.items()},
+    }
+
+
+def main(smoke: bool = False) -> None:
+    result = run_bench(smoke=smoke)
+    # round-over-round gate (benches/regress.py): same policy as bench.py —
+    # a clean run is appended to history, a regressed run is not
+    try:
+        from benches import regress
+
+        regressions, lines = regress.check(result, regress.load_history())
+        result["regressed"] = regressions
+        log(f"regression gate vs stored history, tolerance "
+            f"{regress.DEFAULT_TOLERANCE:.0%}:")
+        for ln in lines:
+            log(ln)
+        if regressions:
+            log(f"FAIL: regressed metrics: {', '.join(regressions)} "
+                f"(run NOT recorded)")
+        else:
+            regress.record(result)
+            log("PASS: run appended to benches/history.json")
+    except Exception as e:  # noqa: BLE001 - gating must not break the bench
+        log(f"regression gate skipped: {e}")
+        result["regressed"] = None
+        result["gate_error"] = str(e)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

@@ -70,6 +70,14 @@ def direction(name: str) -> Optional[str]:
     # lower-is-better check and gate throughput backwards
     if name.endswith(("_per_s", "_acc")):
         return "up"
+    # wire-traffic series (benches/bench_rpc_sync.py, bench_comms.py):
+    # bytes gate DOWN so a PR that silently re-inflates the broadcast or
+    # fan-in payloads fails the gate; `*_info` fields are context only
+    # (e.g. the default path's loss, whose gating belongs to ITS series)
+    if name.endswith("_info"):
+        return None
+    if name.endswith("_bytes"):
+        return "down"
     # *_loss gates DOWN: the north star is epoch time AT MATCHED final
     # loss (BASELINE.md), so the loss half of the pair must gate too —
     # final_acc alone is an insensitive proxy for a convergence break

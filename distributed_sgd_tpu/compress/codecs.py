@@ -60,7 +60,17 @@ class _ResidualStateMixin:
     reply in a batch window when a sibling worker fails, core/master.py)
     use these to roll the drain back before re-encoding for the retry —
     otherwise each retry permanently loses the largest-magnitude gradient
-    coordinates (see core/worker.py Gradient).
+    coordinates.  Retries are recognized by a caller-chosen window key
+    (core/worker.py encode_sync_grad: weights bytes + broadcast
+    step_version under the pipelined sync engine, where retry windows may
+    carry no weight payload at all — docs/SYNC_PIPELINE.md).
+
+    The residual mechanics are payload-agnostic: K-step local-SGD windows
+    (GradientRequest.local_steps) reply with lr-scaled weight-space
+    decrements instead of raw gradient sums, and the same snapshot/
+    restore/drop lifecycle applies unchanged — the residual simply
+    accumulates unsent delta mass in the same (weight) space the wire
+    ships.
     """
 
     def residual_snapshot(self, dest: Hashable):

@@ -84,6 +84,16 @@ class Config:
     compress: str = "none"  # none | topk | qint8
     compress_k: float = 0.01  # topk size: fraction of dim if < 1, count if >= 1
     compress_ef: bool = True  # error-feedback residual accumulation
+    # pipelined sync RPC engine (docs/SYNC_PIPELINE.md; engine=rpc sync fits
+    # only — the mesh engines have no wire, async has no barrier).  Both
+    # default off: the default wire stays byte-identical to the seed.
+    # local_steps=K runs K device-side SGD steps per round on each worker
+    # (K x fewer barriers/broadcasts per epoch, local-SGD semantics);
+    # delta_broadcast replaces the per-window full dense weight broadcast
+    # with versioned sparse deltas over worker-side replica caches, with
+    # automatic full-broadcast fallback on any mismatch.
+    local_steps: int = 1  # sync rpc: K local SGD steps per round
+    delta_broadcast: bool = False  # sync rpc: versioned sparse weight broadcasts
     # tensor parallelism: shard the blocked weight rows over F feature
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
@@ -128,6 +138,8 @@ class Config:
             raise ValueError("checkpoint_every must be >= 1")
         if self.steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        if self.local_steps < 1:
+            raise ValueError("local_steps must be >= 1")
         if self.compress_k <= 0:
             raise ValueError("compress_k must be > 0 (fraction of dim or count)")
         if self.feature_shards < 1:
@@ -227,6 +239,8 @@ class Config:
             compress=_env("DSGD_COMPRESS", cls.compress, str),
             compress_k=_env("DSGD_COMPRESS_K", cls.compress_k, float),
             compress_ef=_env("DSGD_COMPRESS_EF", cls.compress_ef, bool),
+            local_steps=_env("DSGD_LOCAL_STEPS", cls.local_steps, int),
+            delta_broadcast=_env("DSGD_DELTA_BROADCAST", cls.delta_broadcast, bool),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
             role_override=_env("DSGD_ROLE", None, str),
             serve_port=_env("DSGD_SERVE_PORT", cls.serve_port, int),

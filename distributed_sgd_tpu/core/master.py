@@ -80,21 +80,151 @@ class _FailureTracker:
         return n, False
 
 
-def _await_futures(futs):
+def _await_futures(futs, bytes_counter=None):
     """Barrier with failure classification over [(key, future-or-None)].
 
     Returns (ok, failed): ok = [(key, reply)] in input order, failed =
     [(key, status-or-error)].  A None future stands for a channel that
-    closed under us at call time."""
+    closed under us at call time.  `bytes_counter` (optional) accounts
+    every reply that ARRIVED, right here in result handling — so a window
+    later discarded because a sibling failed (fit_sync's retry) still
+    counts its received bytes, which the old post-barrier sum missed."""
     ok, failed = [], []
     for key, fut in futs:
         try:
             if fut is None:
                 raise ValueError("channel closed")
-            ok.append((key, fut.result()))
+            reply = fut.result()
+            if bytes_counter is not None:
+                bytes_counter.increment(reply.ByteSize())
+            ok.append((key, reply))
         except (grpc.RpcError, ValueError) as e:
             failed.append((key, e.code() if isinstance(e, grpc.RpcError) else e))
     return ok, failed
+
+
+def _draw_ids(rng: np.random.Generator, part: np.ndarray, start: int,
+              size: int) -> np.ndarray:
+    """Uniform without-replacement draw of up to `size` sample ids from one
+    worker's partition, clipped by the epoch cursor exactly like the
+    reference's slice of a fresh permutation.
+
+    The reference (Master.scala:184) re-permutes the ENTIRE partition
+    every batch window and slices [start : start+size] — a fresh
+    permutation per window makes that slice nothing more than a uniform
+    without-replacement draw of min(size, len(part)-start) ids, at
+    O(|part|) host work per window.  Generator.choice(replace=False) draws
+    the same distribution at O(size): ~16 us vs ~6 ms on a 200k-sample
+    partition.  The stream stays keyed by (seed, epoch) in the caller, so
+    checkpoint resume replays identical draws."""
+    take = min(int(size), max(0, len(part) - start))
+    if take <= 0:
+        return np.empty(0, dtype=np.int64)
+    return np.asarray(part)[rng.choice(len(part), size=take, replace=False)]
+
+
+class _BroadcastState:
+    """Versioned master->worker weight broadcast for fit_sync
+    (docs/SYNC_PIPELINE.md).
+
+    Tracks the master's weight version, each worker's last-acknowledged
+    replica version, and encodes — at most once per version — the wire
+    forms a window can need: the full tensor, the sparse WeightDelta vs
+    the previous version (absolute new values at the changed coordinates),
+    or nothing at all (header-only, when the worker's replica is already
+    current — retry windows re-serialize zero bytes).  With
+    `delta_broadcast` off it degrades to the pre-pipeline wire — every
+    request carries the full dense tensor and no version fields, byte-
+    identical to the seed — while still re-encoding only when the weights
+    actually changed.
+
+    The sparse form is used only while it is cheaper than the tensor
+    (8 bytes/changed coordinate vs 4 bytes/element dense: break-even at
+    50% density); denser updates fall back to a full broadcast, as do a
+    (re)joined worker, a worker more than one version behind, and any
+    stale_version reply.
+    """
+
+    SPARSE_BREAK_EVEN = 0.5  # changed fraction above which dense is smaller
+
+    def __init__(self, delta_broadcast: bool, metrics):
+        self.delta_broadcast = delta_broadcast
+        self.metrics = metrics
+        # versions start at 1: step_version=0 on the wire means "no version
+        # tracking" (a pre-pipeline master), and the workers' EF retry
+        # guard keys on the version alone whenever one is present — a
+        # retried window may switch wire form (full -> header-only) while
+        # keeping its version, so the version must never be ambiguous
+        self.version = 1 if delta_broadcast else 0
+        self._worker_ver: Dict[Tuple[str, int], int] = {}
+        self._w_prev: Optional[np.ndarray] = None
+        self._full_msg = None     # encoded lazily, once per version
+        self._delta_msg = None    # pb.WeightDelta, False = dense fallback
+
+    def advance(self, w_new: np.ndarray, w_old: np.ndarray) -> None:
+        """Weights moved: bump the version and invalidate encoded forms."""
+        self.version += 1
+        self._w_prev = w_old
+        self._full_msg = None
+        self._delta_msg = None
+
+    def note_ok(self, key) -> None:
+        self._worker_ver[key] = self.version
+
+    def note_stale(self, key) -> None:
+        self._worker_ver.pop(key, None)
+
+    def forget_missing(self, keys) -> None:
+        """Membership changed: drop version claims for departed workers so
+        a same-endpoint rejoin starts from a full broadcast."""
+        live = set(keys)
+        for k in [k for k in self._worker_ver if k not in live]:
+            self._worker_ver.pop(k, None)
+
+    def populate(self, req, key, w: np.ndarray) -> None:
+        """Attach the cheapest valid weight arm for worker `key` to `req`
+        and account it (utils/metrics.py master.sync.bcast.*)."""
+        if not self.delta_broadcast:
+            full = self._full(w)
+            req.weights.CopyFrom(full)
+            metrics_mod.record_broadcast(self.metrics, "full", full.ByteSize())
+            return
+        req.step_version = self.version
+        wv = self._worker_ver.get(key)
+        if wv == self.version:
+            metrics_mod.record_broadcast(self.metrics, "cached", 0)
+            return
+        if wv is not None and wv == self.version - 1:
+            delta = self._delta(w)
+            if delta is not None:
+                req.delta.CopyFrom(delta)
+                metrics_mod.record_broadcast(
+                    self.metrics, "delta", delta.ByteSize())
+                return
+        full = self._full(w)
+        req.weights.CopyFrom(full)
+        metrics_mod.record_broadcast(self.metrics, "full", full.ByteSize())
+
+    def _full(self, w: np.ndarray):
+        if self._full_msg is None:
+            self._full_msg = codec.encode_tensor(w)
+        return self._full_msg
+
+    def _delta(self, w: np.ndarray):
+        if self._delta_msg is None:
+            if self._w_prev is None:
+                self._delta_msg = False
+            else:
+                changed = np.nonzero(w != self._w_prev)[0]
+                if len(changed) > self.SPARSE_BREAK_EVEN * len(w):
+                    self._delta_msg = False  # dense-ish: full is smaller
+                else:
+                    self._delta_msg = pb.WeightDelta(
+                        base_version=self.version - 1,
+                        indices=changed.astype(np.int32),
+                        values=np.ascontiguousarray(w[changed]),
+                    )
+        return self._delta_msg or None
 
 
 class MasterNode:
@@ -394,8 +524,10 @@ class MasterNode:
         checkpoint_every: int = 1,
         optimizer=None,
         momentum: float = 0.9,
+        local_steps: int = 1,
+        delta_broadcast: bool = False,
     ) -> FitResult:
-        """Fault-tolerant sync fit.
+        """Fault-tolerant sync fit, with an optional pipelined wire path.
 
         The reference's barrier (`Future.sequence`, Master.scala:190) hangs
         forever if a worker dies mid-fit.  Here every Gradient call carries a
@@ -419,9 +551,27 @@ class MasterNode:
         an optax transformation): workers still return raw gradient sums
         (Slave.scala:153) and the transformation is applied master-side
         where the reference applies its update.
+
+        Pipelined sync levers (docs/SYNC_PIPELINE.md), each default-off so
+        the default wire stays byte-identical to the unpipelined path:
+
+        - `delta_broadcast=True` (DSGD_DELTA_BROADCAST): versioned sparse
+          weight broadcasts — workers cache the last applied weight vector
+          and the master ships only the changed coordinates (or nothing on
+          retry windows), falling back to a full tensor on worker (re)join,
+          version mismatch (GradUpdate.stale_version), resplit, or a
+          denser-than-break-even update.
+        - `local_steps=K > 1` (DSGD_LOCAL_STEPS): each worker runs K
+          device-side SGD steps per round over K batches drawn from its
+          partition and returns the summed weight-space decrement; the
+          master averages the decrements and applies the result as a
+          pseudo-gradient (mean_delta / learning_rate) through the same
+          optimizer surface — K x fewer barriers and broadcasts per epoch,
+          local-SGD semantics (Stich, 2018) between them.
         """
         if on_worker_death not in ("resplit", "fail"):
             raise ValueError(f"on_worker_death must be resplit|fail, got {on_worker_death!r}")
+        local_steps = max(1, int(local_steps))
         self._require_ready()
         members = self._members()
         keys = [k for k, _ in members]
@@ -437,6 +587,13 @@ class MasterNode:
         tracker = _FailureTracker(grad_retries + 1)
         self._fit_seq += 1
         fit_token = self._fit_token_base + self._fit_seq
+        bcast = _BroadcastState(delta_broadcast, self.metrics)
+        # allocation-free fan-in: one dim-sized accumulator reused by every
+        # window instead of a (workers x dim) dense stack per barrier
+        grad_acc = np.zeros(self.model.n_features, dtype=np.float32)
+        grad_bytes = self.metrics.counter(metrics_mod.SYNC_GRAD_BYTES)
+        rounds = self.metrics.counter(metrics_mod.SYNC_ROUNDS)
+        window_span = batch_size * local_steps
 
         from distributed_sgd_tpu.checkpoint import opt_kind_tag
         from distributed_sgd_tpu.parallel.sync import resolve_optimizer
@@ -491,29 +648,49 @@ class MasterNode:
                     members, keys = current, [k for k, _ in current]
                     parts = split(len(self.train), len(members))
                     max_samples = max(len(p) for p in parts)
+                    bcast.forget_missing(keys)  # rejoins start from full
                     self.log.warning("membership changed; re-split across %d workers",
                                      len(members))
                     if batch >= max_samples:
                         break
                 t_batch = time.perf_counter()
-                wmsg = codec.encode_tensor(w)
                 futs = []
                 for (key, stub), part in zip(members, parts):
-                    shuffled = rng.permutation(part)  # Master.scala:184
-                    ids = shuffled[batch : batch + batch_size]
+                    ids = _draw_ids(rng, part, batch, window_span)
+                    req = pb.GradientRequest(
+                        samples=ids.astype(np.int32), fit_token=fit_token)
+                    if local_steps > 1:
+                        req.local_steps = local_steps
+                        req.batch_size = batch_size
+                        req.learning_rate = learning_rate
+                    bcast.populate(req, key, w)
                     try:
-                        fut = stub.Gradient.future(
-                            pb.GradientRequest(weights=wmsg, samples=ids.astype(np.int32),
-                                               fit_token=fit_token),
-                            timeout=grad_timeout_s,
-                        )
+                        fut = stub.Gradient.future(req, timeout=grad_timeout_s)
                     except ValueError:  # channel closed under us
                         fut = None
                     futs.append((key, fut))
-                ok, failed = _await_futures(futs)  # barrier, with deadlines
+                # barrier, with deadlines; receive-side wire accounting
+                # happens per arriving reply inside _await_futures (send-
+                # side comms.* counters live in the workers' compressors),
+                # so discarded/retried windows are accounted too
+                ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
+                rounds.increment()
+                for key, _ in ok:
+                    tracker.record_ok(key)
+                good, stale = [], []
+                for key, reply in ok:
+                    (stale if reply.stale_version else good).append((key, reply))
+                for key, _ in good:
+                    bcast.note_ok(key)
+                for key, _ in stale:
+                    # replica mismatch (restart, missed window): full
+                    # broadcast on the retry — the correctness fallback
+                    bcast.note_stale(key)
+                    self.metrics.counter(metrics_mod.SYNC_STALE).increment()
+                    self.log.warning(
+                        "worker %s:%d replica stale at v%d; falling back to "
+                        "full broadcast", key[0], key[1], bcast.version)
                 if failed:
-                    for key, _ in ok:
-                        tracker.record_ok(key)
                     for key, code in failed:
                         n, evict = tracker.record_failure(key)
                         if not evict:
@@ -531,24 +708,38 @@ class MasterNode:
                             "worker %s:%d failed Gradient %d times (%s); declaring dead",
                             key[0], key[1], n, code)
                         self.unregister_worker(*key)
-                    continue  # retry this batch window (survivors or re-split)
-                # receive-side wire accounting (send-side comms.* counters
-                # live in the workers' compressors; this is what the MASTER
-                # observed, meaningful when the processes don't share a
-                # metrics registry)
-                self.metrics.counter("master.sync.grad.bytes").increment(
-                    sum(reply.ByteSize() for _, reply in ok))
-                grads = [codec.decode_grad(reply) for _, reply in ok]
-                grad = np.mean(grads, axis=0)  # Vec.mean (Master.scala:194)
-                if opt is None:
-                    w = w - learning_rate * grad  # Master.scala:197
+                if failed or stale:
+                    continue  # retry this window (survivors or re-split)
+                # allocation-free fan-in: scatter/add every reply into the
+                # preallocated accumulator, then scale once — replaces the
+                # per-window [decode_grad(r) for r in ok] dense stack +
+                # np.mean (Vec.mean, Master.scala:194)
+                grad_acc.fill(0.0)
+                for _, reply in good:
+                    codec.decode_grad_into(reply, grad_acc)
+                grad_acc /= len(good)  # true divide, bit-matching np.mean
+                w_old = w
+                if local_steps > 1:
+                    # replies are summed weight-space decrements; apply the
+                    # mean as a pseudo-gradient through the same optimizer
+                    # surface (error-feedback discipline of local SGD)
+                    if opt is None:
+                        w = w - grad_acc
+                    else:
+                        w_j, opt_state = _opt_step(
+                            jnp.asarray(w), opt_state,
+                            jnp.asarray(grad_acc) / learning_rate)
+                        w = np.asarray(w_j)
+                elif opt is None:
+                    w = w - learning_rate * grad_acc  # Master.scala:197
                 else:
                     w_j, opt_state = _opt_step(
-                        jnp.asarray(w), opt_state, jnp.asarray(grad))
+                        jnp.asarray(w), opt_state, jnp.asarray(grad_acc))
                     w = np.asarray(w_j)
+                bcast.advance(w, w_old)
                 self.metrics.histogram("master.sync.batch.duration").record(
                     time.perf_counter() - t_batch)
-                batch += batch_size
+                batch += window_span
             epoch_s = time.perf_counter() - t0
 
             loss, acc = self.local_loss(w)

@@ -82,12 +82,21 @@ class WorkerNode:
         self._compressor = make_compressor(
             compress, k=compress_k, error_feedback=compress_ef,
             seed=seed + port, metrics=self.metrics)
-        # sync-reply EF retry guard: (weights bytes, residual snapshot) of
+        # sync-reply EF retry guard: (window key, residual snapshot) of
         # the last Gradient request, plus the fit-session token last seen —
-        # see encode_sync_grad
-        self._sync_ef_guard: Tuple[Optional[bytes], Optional[np.ndarray]] = (
+        # see encode_sync_grad.  The key is the broadcast step_version
+        # under the versioned wire (retries repeat it even when the wire
+        # form changes), the raw weight bytes under the pre-pipeline wire
+        self._sync_ef_guard: Tuple[Optional[object], Optional[np.ndarray]] = (
             None, None)
         self._sync_fit_token = 0
+        # versioned weight-replica cache for the pipelined sync path
+        # (docs/SYNC_PIPELINE.md): the last applied weight vector keyed by
+        # (fit_token, step_version), so the master can broadcast sparse
+        # WeightDeltas (or nothing at all on retry windows) instead of the
+        # full dense tensor — see resolve_request_weights
+        self._replica_lock = threading.Lock()
+        self._replica: Optional[Tuple[int, int, np.ndarray]] = None
         # k local SGD steps per compiled dispatch; the summed delta is
         # gossiped every k steps (deltas commute — same amortization as
         # parallel/hogwild.py, GradUpdate.n_steps carries k on the wire).
@@ -259,7 +268,109 @@ class WorkerNode:
         self.metrics.counter("slave.sync.backward").increment()
         return np.asarray(g)
 
-    def encode_sync_grad(self, g: np.ndarray, weights_bytes: bytes,
+    # -- pipelined sync engine (docs/SYNC_PIPELINE.md) ---------------------
+
+    def resolve_request_weights(self, request):
+        """Versioned weight resolution for the sync Gradient path.
+
+        Returns (weights, stale).  A full broadcast (`weights` set)
+        installs the replica at `step_version`; a WeightDelta assigns the
+        master's ABSOLUTE new values at `delta.indices` on top of the
+        cached replica when `base_version` matches; a header-only request
+        (neither arm set, version tracking on) reuses the replica as-is.
+        Any mismatch — empty cache after a (re)start, wrong base version,
+        wrong fit session — returns stale=True WITHOUT computing anything:
+        the master falls back to a full broadcast on the retry window.
+
+        A request whose target version the replica already holds returns
+        the cache directly regardless of arm, so a delta re-sent after a
+        lost reply is never applied twice (the absolute-value encoding
+        would make re-application harmless anyway; the version check makes
+        it structural).  Pre-pipeline masters always send full weights
+        with step_version=0, which lands in the install arm every window —
+        identical behavior to the unversioned wire.
+        """
+        tok = request.fit_token
+        version = request.step_version
+        with self._replica_lock:
+            if self._replica is not None and self._replica[0] != tok:
+                self._replica = None  # new fit session: drop the old replica
+            if request.HasField("weights"):
+                w = codec.decode_tensor(request.weights)
+                self._replica = (tok, version, w)
+                return w, False
+            if self._replica is None:
+                return None, True
+            _, cached_ver, cached = self._replica
+            if cached_ver == version:
+                return cached, False  # retry / already-applied: idempotent
+            if request.HasField("delta") and cached_ver == request.delta.base_version:
+                d = request.delta
+                w = cached.copy()
+                if len(d.indices):
+                    w[np.asarray(d.indices, dtype=np.int64)] = np.asarray(
+                        d.values, dtype=np.float32)
+                self._replica = (tok, version, w)
+                return w, False
+            return None, True
+
+    def _window_fn(self, steps: int, capacity: int):
+        """K-step local-SGD window (GradientRequest.local_steps), jitted per
+        (steps, per-step capacity): a lax.scan of the same sum-reduced
+        regularized gradient as _grad_fn, each step applying the
+        reference's plain update w -= lr * g locally.  Returns the summed
+        weight-space decrement w_start - w_end — at K=1 this is exactly
+        lr * compute_gradient(w, ids), so the master recovers the same
+        pseudo-gradient the one-batch window would have produced."""
+        model = self.model
+        blocked = self._blocked_device()
+        key = ("window", steps, capacity)
+        if key not in self._grad_cache:
+
+            def fn(w, idx, val, y, ids, valid, lr):
+                def body(w_t, inp):
+                    ids_t, valid_t = inp
+                    rows_i = idx[ids_t]
+                    rows_v = val[ids_t] * valid_t[:, None]  # zero rows for pads
+                    batch = SparseBatch(rows_i, rows_v)
+                    by = y[ids_t] * valid_t.astype(y.dtype)
+                    g = model.grad_regularized(w_t, batch, by, blocked=blocked)
+                    return w_t - lr * g, None
+
+                w_end, _ = jax.lax.scan(body, w, (ids, valid))
+                return w - w_end
+
+            self._grad_cache[key] = jax.jit(fn)
+        return self._grad_cache[key]
+
+    def compute_local_window(self, w: np.ndarray, ids: np.ndarray, k: int,
+                             batch_size: int, learning_rate: float) -> np.ndarray:
+        """Run up to `k` local SGD steps over `ids` split into
+        `batch_size`-sized batches; returns the summed decrement delta.
+        The final (or only) batch may be short — epoch tails send fewer
+        than k*batch_size ids — and is masked out via zeroed rows, so each
+        (steps, batch_size) shape compiles exactly once."""
+        bs = max(1, int(batch_size))
+        n = len(ids)
+        # step count derives from the ids actually sent, capped at k so an
+        # oversized sample list cannot run more local steps than the wire
+        # contract (GradientRequest.local_steps) allows
+        steps = max(1, min(-(-n // bs), max(1, int(k))))
+        n = min(n, steps * bs)  # excess ids beyond the k-step budget dropped
+        padded = np.zeros(steps * bs, dtype=np.int32)
+        padded[:n] = np.asarray(ids[:n], dtype=np.int32)
+        valid = np.zeros(steps * bs, dtype=np.float32)
+        valid[:n] = 1.0
+        delta = self._window_fn(steps, bs)(
+            jnp.asarray(w), self._idx, self._val, self._y,
+            jnp.asarray(padded.reshape(steps, bs)),
+            jnp.asarray(valid.reshape(steps, bs)),
+            jnp.float32(learning_rate),
+        )
+        self.metrics.counter("slave.sync.backward").increment(steps)
+        return np.asarray(delta)
+
+    def encode_sync_grad(self, g: np.ndarray, window_key,
                          fit_token: int = 0):
         """Compressed Gradient reply with at-most-once residual drain.
 
@@ -268,13 +379,17 @@ class WorkerNode:
         window when a sibling worker fails and retries the whole window
         (core/master.py fit_sync) — without compensation each retry would
         permanently lose this worker's largest-magnitude coordinates.  A
-        retry is recognizable here: it carries byte-identical weights (the
-        master only advances w after a fully-successful window), so on a
-        repeat of the previous request's weights the pre-drain residual is
-        restored before re-encoding.  (Identical weights across *different*
-        windows would need an exactly-zero update — in which case the
-        restored and current residuals coincide and the rollback is a
-        no-op.)
+        retry is recognizable here by `window_key` — the broadcast
+        step_version when the master versions its broadcasts (versions
+        start at 1 and only advance after a fully-successful window, and
+        a retry repeats the version even when the wire FORM changed, e.g.
+        a full broadcast downgrading to header-only once this worker
+        acknowledged it), the raw weight bytes otherwise (byte-identical
+        weights = retry, the pre-pipeline rule).  On a repeated key the
+        pre-drain residual is restored before re-encoding.  (Identical
+        weights across *different* windows would need an exactly-zero
+        update — in which case the restored and current residuals
+        coincide and the rollback is a no-op.)
 
         `fit_token` scopes the residual to ONE fit: the master stamps each
         fit_sync's requests with a fresh token, and a token change drops
@@ -287,12 +402,12 @@ class WorkerNode:
             self._sync_fit_token = fit_token
             self._compressor.residual_drop("sync:master")
             self._sync_ef_guard = (None, None)
-        prev_w, prev_res = self._sync_ef_guard
-        if prev_w is not None and prev_w == weights_bytes:
+        prev_key, prev_res = self._sync_ef_guard
+        if prev_key is not None and prev_key == window_key:
             self._compressor.residual_restore("sync:master", prev_res)
         else:
             self._sync_ef_guard = (
-                weights_bytes,
+                window_key,
                 self._compressor.residual_snapshot("sync:master"),
             )
         return self._compressor.compress(g, dest="sync:master")
@@ -482,16 +597,35 @@ class _WorkerServicer:
         return pb.ForwardReply(predictions=preds)
 
     def Gradient(self, request, context):  # noqa: N802
-        w = codec.decode_tensor(request.weights)
+        w, stale = self.w.resolve_request_weights(request)
+        if stale:
+            # replica/version mismatch: no gradient to give — the master
+            # falls back to a full broadcast on the retry window
+            self.w.metrics.counter("slave.sync.stale").increment()
+            return pb.GradUpdate(stale_version=True)
         ids = np.fromiter(request.samples, dtype=np.int64)
-        g = self.w.compute_gradient(w, ids)
+        k = request.local_steps
+        if k > 1:
+            g = self.w.compute_local_window(
+                w, ids, k, request.batch_size, request.learning_rate)
+        else:
+            g = self.w.compute_gradient(w, ids)
         # sync fan-in reply: compressed when configured (EF residual keyed
         # to the one sync destination — this worker answers one master),
         # with the retry-rollback + fit-session guards of encode_sync_grad
         if self.w._compressor is not None:
-            return self.w.encode_sync_grad(g, request.weights.data,
-                                           request.fit_token)
-        return codec.encode_grad(g)
+            # retry-window key: the step_version when the master versions
+            # its broadcasts (a retry repeats the version even if the wire
+            # form changed, e.g. full -> header-only after a mid-window
+            # fallback), the weight bytes otherwise (pre-pipeline wire:
+            # byte-identical weights = retry)
+            window_key = request.step_version or request.weights.data
+            msg = self.w.encode_sync_grad(g, window_key, request.fit_token)
+        else:
+            msg = codec.encode_grad(g)
+        if k > 1:
+            msg.n_steps = k  # wire accounting: steps amortized per round
+        return msg
 
     def StartAsync(self, request, context):  # noqa: N802
         self.w.start_async(

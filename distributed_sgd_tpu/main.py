@@ -156,6 +156,13 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
         log.warning(
             "DSGD_COMPRESS=%s ignored: in-mesh engines have no wire path "
             "(use engine=rpc or async_mode=gossip)", cfg.compress)
+    if cfg.local_steps > 1 or cfg.delta_broadcast:
+        # the pipelined sync levers shape RPC wire traffic; the mesh
+        # engines exchange gradients through XLA collectives
+        log.warning(
+            "DSGD_LOCAL_STEPS/DSGD_DELTA_BROADCAST ignored: the pipelined "
+            "sync engine is the rpc topology's (use engine=rpc; the mesh "
+            "local-SGD equivalent is async_mode=local_sgd / sync_period)")
     log.info(
         "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
         n, virtual, cfg.kernel, cfg.model, cfg.use_async,
@@ -248,6 +255,8 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
                 optimizer=cfg.optimizer, momentum=cfg.momentum,
+                local_steps=cfg.local_steps,
+                delta_broadcast=cfg.delta_broadcast,
             )
         _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True),
                 saved=ckpt is not None)
@@ -363,6 +372,8 @@ def _run_role(cfg: Config, role: str) -> None:
                 cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
                 checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
                 optimizer=cfg.optimizer, momentum=cfg.momentum,
+                local_steps=cfg.local_steps,
+                delta_broadcast=cfg.delta_broadcast,
             )
         _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True),
                 saved=ckpt is not None)

@@ -222,6 +222,30 @@ def record_wire(metrics: "Metrics", wire_bytes: int, dense_bytes: int) -> None:
         metrics.histogram(COMMS_RATIO).record(dense_bytes / wire_bytes)
 
 
+# -- pipelined sync engine (docs/SYNC_PIPELINE.md) ---------------------------
+#
+# Master-side instruments for the RPC sync fan-out/fan-in loop
+# (core/master.py fit_sync).  `rounds` counts every barrier attempt,
+# including windows later discarded to a failed/stale sibling; the bcast.*
+# family decomposes the master->worker weight traffic by wire form, which
+# is what the delta-hit-rate and bytes-per-epoch numbers in
+# benches/bench_rpc_sync.py are computed from.
+SYNC_ROUNDS = "master.sync.rounds"             # counter: fan-out barriers run
+SYNC_GRAD_BYTES = "master.sync.grad.bytes"     # counter: worker->master reply bytes
+SYNC_BCAST_BYTES = "master.sync.bcast.bytes"   # counter: master->worker weight bytes
+SYNC_BCAST_FULL = "master.sync.bcast.full"     # counter: full-tensor sends
+SYNC_BCAST_DELTA = "master.sync.bcast.delta"   # counter: sparse WeightDelta sends
+SYNC_BCAST_CACHED = "master.sync.bcast.cached" # counter: header-only sends (0 bytes)
+SYNC_STALE = "master.sync.bcast.stale"         # counter: stale replies -> full fallback
+
+
+def record_broadcast(metrics: "Metrics", form: str, n_bytes: int) -> None:
+    """Account one master->worker weight send: `form` is 'full' | 'delta' |
+    'cached' (delta-hit-rate = (delta + cached) / total sends)."""
+    metrics.counter(SYNC_BCAST_BYTES).increment(int(n_bytes))
+    metrics.counter(f"master.sync.bcast.{form}").increment()
+
+
 _GLOBAL = Metrics()
 
 

@@ -1,0 +1,430 @@
+"""Pipelined sync RPC engine (docs/SYNC_PIPELINE.md): versioned sparse
+weight-delta broadcasts, K-step local-SGD windows, allocation-free fan-in.
+
+Correctness story under test: the delta transport is EXACT (WeightDelta
+ships absolute values, so the delta path's weights equal the dense path's
+bit-for-bit at K=1), every mismatch falls back to a full broadcast
+(version skew, replica loss, worker death/rejoin), retries can never
+double-apply, and K>1 checkpoint/resume continues the same (seed, epoch)-
+keyed sample stream a fresh run would draw.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.core.master import _await_futures, _draw_ids
+from distributed_sgd_tpu.core.worker import WorkerNode
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.utils import metrics as mm
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(
+        rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=31,
+                  idf_values=True))
+
+
+@pytest.fixture(scope="module")
+def model_fn(data):
+    train, _ = data
+    ds = dim_sparsity(train)
+    return lambda: make_model("hinge", 1e-5, train.n_features,
+                              dim_sparsity=ds)
+
+
+def _counters():
+    g = mm.global_metrics()
+    names = (mm.SYNC_ROUNDS, mm.SYNC_BCAST_BYTES, mm.SYNC_BCAST_FULL,
+             mm.SYNC_BCAST_DELTA, mm.SYNC_BCAST_CACHED, mm.SYNC_STALE)
+    return {n: g.counter(n).value for n in names}
+
+
+def _fit(cluster, **kw):
+    kw.setdefault("max_epochs", 2)
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("learning_rate", 0.5)
+    return cluster.master.fit_sync(**kw)
+
+
+# -- exactness + wire accounting ---------------------------------------------
+
+
+def test_delta_broadcast_exact_and_cheaper_at_k1(data, model_fn):
+    """The versioned sparse transport must reconstruct the dense path's
+    weights EXACTLY (absolute-value deltas) while sending fewer bytes."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        dense = _fit(c)
+    b0 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        delta = _fit(c, delta_broadcast=True)
+    b1 = _counters()
+    assert np.array_equal(dense.state.weights, delta.state.weights)
+    sent = {k: b1[k] - b0[k] for k in b0}
+    assert sent[mm.SYNC_BCAST_DELTA] > 0, "no sparse delta was ever sent"
+    # window 0 is always full (one per worker); early windows at this tiny
+    # dim may also fall back (update support above the sparse break-even),
+    # but the steady state must be deltas
+    assert sent[mm.SYNC_BCAST_FULL] >= 2
+    assert sent[mm.SYNC_BCAST_DELTA] > sent[mm.SYNC_BCAST_FULL]
+
+
+def test_knobs_off_requests_carry_no_pipeline_fields(data, model_fn):
+    """Default-config byte-identity: with both levers off, every request
+    the workers see is the pre-PR wire — full weights, no delta, no
+    version, no local-step fields (unset proto3 scalars serialize to
+    nothing, so this is equivalent to byte-identity on the wire)."""
+    train, test = data
+    seen = []
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        for w in c.workers:
+            orig = w.resolve_request_weights
+
+            def spy(request, _orig=orig):
+                seen.append((request.HasField("weights"),
+                             request.HasField("delta"),
+                             request.step_version, request.local_steps,
+                             request.batch_size, request.learning_rate))
+                return _orig(request)
+
+            w.resolve_request_weights = spy
+        _fit(c, max_epochs=1)
+    assert seen, "no Gradient request observed"
+    for has_w, has_d, ver, k, bs, lr in seen:
+        assert has_w and not has_d
+        assert ver == 0 and k == 0 and bs == 0 and lr == 0.0
+
+
+def test_rounds_counter_and_window_span(data, model_fn):
+    """K=4 runs ~K x fewer barriers per epoch, counted by the new
+    master.sync.rounds counter."""
+    train, test = data
+    b0 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        _fit(c, max_epochs=1)
+    b1 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        _fit(c, max_epochs=1, local_steps=4, delta_broadcast=True)
+    b2 = _counters()
+    r_default = b1[mm.SYNC_ROUNDS] - b0[mm.SYNC_ROUNDS]
+    r_k4 = b2[mm.SYNC_ROUNDS] - b1[mm.SYNC_ROUNDS]
+    # 128 samples/worker: ceil(128/16)=8 vs ceil(128/64)=2
+    assert r_default == 8
+    assert r_k4 == 2
+
+
+# -- fault fallbacks ----------------------------------------------------------
+
+
+def test_replica_loss_falls_back_to_full_broadcast(data, model_fn):
+    """Clobbering a worker's replica mid-fit (as a process restart would)
+    must produce a stale reply, a full-broadcast retry, and an unchanged
+    final result vs the master's own weights."""
+    train, test = data
+    b0 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        victim = c.workers[0]
+        orig = victim.resolve_request_weights
+        calls = {"n": 0}
+
+        def clobber_then_resolve(request):
+            calls["n"] += 1
+            if calls["n"] == 5:  # mid-fit, after deltas started flowing
+                with victim._replica_lock:
+                    victim._replica = None
+            return orig(request)
+
+        victim.resolve_request_weights = clobber_then_resolve
+        res = _fit(c, delta_broadcast=True)
+        # the clobbered worker recovered a live replica (full-broadcast
+        # fallback) and kept serving windows to the end of the fit: its
+        # replica is the weights of the LAST window's broadcast (the master
+        # advances one more version after the final gradient barrier)
+        assert victim._replica is not None
+    b1 = _counters()
+    assert b1[mm.SYNC_STALE] - b0[mm.SYNC_STALE] >= 1
+    assert res.losses[-1] < res.losses[0]
+
+
+def test_worker_death_resplit_under_delta_broadcast(data, model_fn):
+    """Hard-kill a worker mid-fit with the pipelined path on: the default
+    resplit policy must absorb it exactly as the dense path does."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=3) as c:
+        gone = c.workers[0]
+        first_call = threading.Event()
+        # K>1 windows go through compute_local_window, so trace the weight
+        # resolution every Gradient request performs first
+        orig = gone.resolve_request_weights
+
+        def traced(request):
+            first_call.set()
+            return orig(request)
+
+        gone.resolve_request_weights = traced
+        box = {}
+
+        def run():
+            try:
+                box["result"] = _fit(c, max_epochs=4, grad_timeout_s=5.0,
+                                     delta_broadcast=True, local_steps=2)
+            except Exception as e:  # noqa: BLE001 - surfaced to the test
+                box["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert first_call.wait(30), "fit never reached a worker"
+        gone._stopped.set()
+        gone.server.stop(grace=0)
+        t.join(timeout=120)
+        assert not t.is_alive(), "fit_sync hung after worker death"
+        assert "error" not in box, f"fit raised: {box.get('error')}"
+        res = box["result"]
+        assert res.epochs_run == 4
+        assert res.losses[-1] < res.losses[0]
+        assert len(c.master._workers) == 2
+
+
+# -- worker-side replica state machine (no cluster needed) --------------------
+
+
+@pytest.fixture()
+def lone_worker(data, model_fn):
+    train, _ = data
+    w = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, train, model_fn())
+    yield w
+    w._master_channel.close()
+    w.server.stop(grace=0)
+
+
+def _full_req(w_vec, version, tok=9):
+    return pb.GradientRequest(weights=codec.encode_tensor(w_vec),
+                              step_version=version, fit_token=tok)
+
+
+def _delta_req(base, version, idx, vals, tok=9):
+    r = pb.GradientRequest(step_version=version, fit_token=tok)
+    r.delta.CopyFrom(pb.WeightDelta(
+        base_version=base, indices=np.asarray(idx, np.int32),
+        values=np.asarray(vals, np.float32)))
+    return r
+
+
+def _header_req(version, tok=9):
+    return pb.GradientRequest(step_version=version, fit_token=tok)
+
+
+def test_replica_state_machine_and_idempotent_retry(lone_worker):
+    wk = lone_worker
+    dim = wk.model.n_features
+    w1 = np.arange(dim, dtype=np.float32)
+
+    w, stale = wk.resolve_request_weights(_full_req(w1, 1))
+    assert not stale and np.array_equal(w, w1)
+
+    # sparse delta on top of v1 -> v2 (absolute values)
+    w2 = w1.copy()
+    w2[[3, 7]] = [100.0, -5.0]
+    w, stale = wk.resolve_request_weights(_delta_req(1, 2, [3, 7], [100.0, -5.0]))
+    assert not stale and np.array_equal(w, w2)
+
+    # retry of the same delta after a lost reply: replica already at v2 —
+    # served from cache, NOT applied twice
+    w, stale = wk.resolve_request_weights(_delta_req(1, 2, [3, 7], [100.0, -5.0]))
+    assert not stale and np.array_equal(w, w2)
+
+    # header-only at the current version: cache hit
+    w, stale = wk.resolve_request_weights(_header_req(2))
+    assert not stale and np.array_equal(w, w2)
+
+    # version skew: header-only for a version we never saw -> stale
+    _, stale = wk.resolve_request_weights(_header_req(4))
+    assert stale
+    # delta whose base doesn't match -> stale
+    _, stale = wk.resolve_request_weights(_delta_req(3, 4, [0], [1.0]))
+    assert stale
+
+    # new fit session drops the replica: same version numbers, other token
+    _, stale = wk.resolve_request_weights(_header_req(2, tok=10))
+    assert stale
+    # empty cache + full broadcast recovers
+    w, stale = wk.resolve_request_weights(_full_req(w2, 2, tok=10))
+    assert not stale and np.array_equal(w, w2)
+
+
+def test_local_window_matches_k_manual_steps(lone_worker, data, model_fn):
+    """compute_local_window == K explicit (gradient, update) iterations."""
+    train, _ = data
+    wk = lone_worker
+    model = model_fn()
+    dim = model.n_features
+    rng = np.random.default_rng(3)
+    w0 = rng.normal(size=dim).astype(np.float32) * 0.1
+    ids = rng.choice(len(train), size=3 * 8, replace=False)
+    lr = 0.25
+
+    delta = wk.compute_local_window(w0, ids, k=3, batch_size=8,
+                                    learning_rate=lr)
+    w_ref = w0.copy()
+    for s in range(3):
+        g = wk.compute_gradient(w_ref, ids[s * 8:(s + 1) * 8])
+        w_ref = w_ref - lr * g
+    np.testing.assert_allclose(w0 - delta, w_ref, rtol=0, atol=1e-5)
+    # K=1 window degenerates to lr * compute_gradient
+    d1 = wk.compute_local_window(w0, ids[:8], k=1, batch_size=8,
+                                 learning_rate=lr)
+    np.testing.assert_allclose(
+        d1, lr * wk.compute_gradient(w0, ids[:8]), rtol=0, atol=1e-5)
+    # short tail: 5 ids at batch_size 8 pads with masked rows
+    d_tail = wk.compute_local_window(w0, ids[:5], k=2, batch_size=8,
+                                     learning_rate=lr)
+    assert d_tail.shape == (dim,)
+    assert np.isfinite(d_tail).all()
+    # oversized id list: the k-step budget caps the work (wire contract),
+    # excess ids are dropped — identical to the 2-step run over ids[:16]
+    d_cap = wk.compute_local_window(w0, ids, k=2, batch_size=8,
+                                    learning_rate=lr)
+    d_two = wk.compute_local_window(w0, ids[:16], k=2, batch_size=8,
+                                    learning_rate=lr)
+    np.testing.assert_array_equal(d_cap, d_two)
+
+
+# -- K>1 semantics ------------------------------------------------------------
+
+
+def test_local_steps_converges(data, model_fn):
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        res = _fit(c, max_epochs=3, local_steps=4)
+    assert res.losses[-1] < res.losses[0]
+
+
+def test_local_steps_checkpoint_resume_continues_stream(
+        data, model_fn, tmp_path):
+    """A K=4 fit interrupted at an epoch boundary and resumed must land on
+    the same weights as an uninterrupted run: the sample stream is keyed
+    by (seed, epoch), not by wall-clock or prior windows."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    train, test = data
+    kw = dict(local_steps=4, delta_broadcast=True, checkpoint_every=1)
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        full = _fit(c, max_epochs=4,
+                    checkpointer=Checkpointer(str(tmp_path / "a")), **kw)
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        _fit(c, max_epochs=2,
+             checkpointer=Checkpointer(str(tmp_path / "b")), **kw)
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        resumed = _fit(c, max_epochs=4,
+                       checkpointer=Checkpointer(str(tmp_path / "b")), **kw)
+    np.testing.assert_allclose(
+        resumed.state.weights, full.state.weights, rtol=0, atol=1e-6)
+
+
+# -- helpers: draw, fan-in, barrier accounting --------------------------------
+
+
+def test_draw_ids_semantics():
+    part = np.arange(1000, 1200)
+    rng = np.random.default_rng((0, 3))
+    ids = _draw_ids(rng, part, 0, 16)
+    assert len(ids) == 16
+    assert len(np.unique(ids)) == 16, "draw must be without replacement"
+    assert np.isin(ids, part).all()
+    # deterministic under the (seed, epoch) stream key
+    ids2 = _draw_ids(np.random.default_rng((0, 3)), part, 0, 16)
+    np.testing.assert_array_equal(ids, ids2)
+    # epoch-cursor clipping matches the reference's permutation slice
+    assert len(_draw_ids(rng, part, 192, 16)) == 8
+    assert len(_draw_ids(rng, part, 200, 16)) == 0
+    assert len(_draw_ids(rng, part, 500, 16)) == 0
+
+
+def test_decode_grad_into_matches_decode_grad():
+    rng = np.random.default_rng(5)
+    dim = 300
+    dense_vec = rng.normal(size=dim).astype(np.float32)
+    sparse_vec = dense_vec * (rng.random(dim) < 0.05)
+    support = np.nonzero(sparse_vec)[0]
+    msgs = [
+        pb.GradUpdate(dense=codec.encode_tensor(dense_vec)),
+        codec.encode_grad(sparse_vec),  # auto-picks the sparse arm
+        codec.encode_topk(support, sparse_vec[support], dim),
+        codec.quantize_qint8(dense_vec, np.random.default_rng(0)),
+    ]
+    for msg in msgs:
+        for scale in (1.0, 0.5):
+            out = np.full(dim, 2.0, dtype=np.float32)
+            codec.decode_grad_into(msg, out, scale=scale)
+            expect = 2.0 + scale * codec.decode_grad(msg)
+            np.testing.assert_allclose(out, expect, rtol=0, atol=1e-6)
+
+
+def test_ef_retry_guard_survives_wire_form_change(data, model_fn):
+    """A retried window may downgrade from a full broadcast to header-only
+    (the worker acknowledged the version before a sibling failed).  The
+    compression retry guard must still recognize it as a retry — keyed on
+    the step_version — and roll the residual drain back, so the re-encoded
+    reply ships the SAME coordinates instead of permanently losing them."""
+    from distributed_sgd_tpu.core.worker import _WorkerServicer
+
+    train, _ = data
+    wk = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, train, model_fn(),
+                    compress="topk", compress_k=0.05)
+    try:
+        servicer = _WorkerServicer(wk)
+        ids = np.arange(8, dtype=np.int32)
+        full = pb.GradientRequest(
+            weights=codec.encode_tensor(np.zeros(wk.model.n_features,
+                                                 dtype=np.float32)),
+            samples=ids, fit_token=3, step_version=1)
+        reply1 = servicer.Gradient(full, None)
+        # retry of the SAME window, header-only form (replica already at v1)
+        retry = pb.GradientRequest(samples=ids, fit_token=3, step_version=1)
+        reply2 = servicer.Gradient(retry, None)
+        assert not reply2.stale_version
+        np.testing.assert_array_equal(
+            codec.decode_grad(reply1), codec.decode_grad(reply2))
+    finally:
+        wk._master_channel.close()
+        wk.server.stop(grace=0)
+
+
+@pytest.mark.slow
+def test_rpc_smoke_bench_end_to_end():
+    """`bench.py --rpc --smoke` is the CI entry point for the pipelined
+    sync engine: it must keep asserting delta==dense exactness and the
+    convergence-parity gate, and report the wire reductions."""
+    from benches.bench_rpc_sync import run_bench
+
+    r = run_bench(smoke=True)  # raises on drift or parity failure
+    assert r["delta_k1_max_drift"] <= 1e-6
+    assert r["loss_parity_ok"] == 1
+    assert r["bcast_reduction_x"] >= 5.0
+    assert r["rounds_reduction_x"] >= 4.0
+
+
+def test_await_futures_accounts_bytes_even_on_failed_windows():
+    class _OkFut:
+        def __init__(self, msg):
+            self._msg = msg
+
+        def result(self):
+            return self._msg
+
+    reply = codec.encode_grad(np.ones(50, dtype=np.float32))
+    counter = mm.Metrics().counter("bytes")
+    ok, failed = _await_futures(
+        [(("a", 1), _OkFut(reply)), (("b", 2), None)],
+        bytes_counter=counter)
+    assert len(ok) == 1 and len(failed) == 1
+    assert counter.value == reply.ByteSize(), (
+        "the arriving reply's bytes must be counted even though the "
+        "window will be retried")
