@@ -318,6 +318,16 @@ def main() -> None:
 
         bench_rpc_sync.main(smoke="--smoke" in sys.argv)
         return
+    if "--chaos" in sys.argv:
+        # chaos gate (docs/FAULT_TOLERANCE.md): sync training under the
+        # canonical seeded fault plan, quorum on vs off — asserts
+        # completion, zero live-worker evictions, convergence parity, and
+        # >= 3x fewer soft-deadline-stalled rounds with DSGD_QUORUM=N-1.
+        # --smoke is the deterministic CI-sized mode.
+        from benches import bench_chaos
+
+        bench_chaos.main(smoke="--smoke" in sys.argv)
+        return
     log("generating RCV1-scale synthetic data...")
     t0 = time.perf_counter()
     idx, val, y = gen_data(N_SAMPLES)

@@ -62,6 +62,24 @@ class Config:
     checkpoint_dir: Optional[str] = None
     checkpoint_every: int = 1  # sync-trainer epoch cadence
     heartbeat_s: Optional[float] = None  # master worker-failure detection period
+    # consecutive heartbeat misses before eviction (was hardcoded 3 in
+    # core/master.py:_heartbeat_loop; docs/FAULT_TOLERANCE.md)
+    heartbeat_max_misses: int = 3
+    # -- chaos-hardened sync training (docs/FAULT_TOLERANCE.md) ------------
+    # quorum: rpc sync fits proceed once `quorum` of N gradient replies are
+    # in hand and the straggler soft deadline fired, hedging the missing
+    # workers' data slices to fast responders (Chen et al. 2016's backup-
+    # replica shape).  None (default) keeps the full barrier — wire and
+    # call graph byte-identical to the quorum-less engine.
+    quorum: Optional[int] = None
+    # soft deadline (seconds) before a quorum round degrades / a stall is
+    # counted; None = p95-adaptive from the per-worker reply-latency EWMA
+    straggler_soft_s: Optional[float] = None
+    # deterministic fault-injection plan applied to every RPC edge of this
+    # process (chaos/), e.g.
+    # "seed=7;drop=0.05;delay=20ms~200ms;dup=0.01;partition=w2:10s@30s";
+    # None/empty = no injection (and no wrapping at all)
+    chaos: Optional[str] = None
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     # InfluxDB write endpoint for the push reporter (reference parity:
     # Kamon InfluxDBReporter, application.conf:54-78), e.g.
@@ -134,6 +152,18 @@ class Config:
                 )
         if self.virtual_workers < 1:
             raise ValueError("virtual_workers must be >= 1")
+        if self.heartbeat_max_misses < 1:
+            raise ValueError("heartbeat_max_misses must be >= 1")
+        if self.quorum is not None and self.quorum < 1:
+            raise ValueError("quorum must be >= 1 (or unset for a full barrier)")
+        if self.straggler_soft_s is not None and self.straggler_soft_s <= 0:
+            raise ValueError("straggler_soft_s must be > 0 (or unset for adaptive)")
+        if self.chaos:
+            # fail typos at construction, not mid-fit: the plan grammar is
+            # owned by chaos.parse_plan
+            from distributed_sgd_tpu.chaos import parse_plan
+
+            parse_plan(self.chaos)
         if self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
         if self.steps_per_dispatch < 1:
@@ -226,6 +256,11 @@ class Config:
             checkpoint_dir=_env("DSGD_CHECKPOINT_DIR", None, str),
             checkpoint_every=_env("DSGD_CHECKPOINT_EVERY", cls.checkpoint_every, int),
             heartbeat_s=_env("DSGD_HEARTBEAT_S", None, float),
+            heartbeat_max_misses=_env("DSGD_HEARTBEAT_MAX_MISSES",
+                                      cls.heartbeat_max_misses, int),
+            quorum=_env("DSGD_QUORUM", None, int),
+            straggler_soft_s=_env("DSGD_STRAGGLER_SOFT_S", None, float),
+            chaos=_env("DSGD_CHAOS", None, str),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             influx_url=_env("DSGD_INFLUX_URL", None, str),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),

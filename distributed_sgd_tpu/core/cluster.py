@@ -35,16 +35,37 @@ class DevCluster:
         devices=None,
         seed: int = 0,
         heartbeat_s: Optional[float] = None,
+        heartbeat_max_misses: int = 3,
         steps_per_dispatch: int = 1,
         compress: str = "none",
         compress_k: float = 0.01,
         compress_ef: bool = True,
+        chaos: Optional[str] = None,
     ):
+        # fault injection (chaos/, DSGD_CHAOS): the plan must be installed
+        # BEFORE any node opens a channel so every stub is wrapped — but it
+        # stays un-armed through cluster formation (registration and peer
+        # introduction run on clear weather) and the fault clock starts at
+        # the await_ready barrier below, which makes partition windows
+        # (@30s) deterministic relative to the start of training
+        self._chaos_installed = False
+        if chaos:
+            from distributed_sgd_tpu import chaos as chaos_mod
+            from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+            chaos_mod.install(chaos, metrics=metrics_mod.global_metrics(),
+                              armed=False)
+            self._chaos_installed = True
         devs = list(devices if devices is not None else jax.devices())
         self.master = MasterNode(
             host, base_port, train, test, model,
             expected_workers=n_workers, seed=seed,
-        ).start(heartbeat_s=heartbeat_s)
+        ).start(heartbeat_s=heartbeat_s,
+                heartbeat_max_misses=heartbeat_max_misses)
+        if self._chaos_installed:
+            from distributed_sgd_tpu import chaos as chaos_mod
+
+            chaos_mod.name_endpoint(host, self.master.port, "master")
         self.workers: List[WorkerNode] = []
         for i in range(n_workers):
             port = 0 if base_port == 0 else base_port + 1 + i
@@ -56,15 +77,28 @@ class DevCluster:
                 compress_ef=compress_ef,
             )
             self.workers.append(w)
+            if self._chaos_installed:
+                from distributed_sgd_tpu import chaos as chaos_mod
+
+                chaos_mod.name_endpoint(host, w.port, f"w{i}")
         for w in self.workers:
             w.start(wait_registered=True)
         self.master.await_ready()
+        if self._chaos_installed:
+            from distributed_sgd_tpu import chaos as chaos_mod
+
+            chaos_mod.arm()
+            log.warning("chaos plan armed: %s", chaos)
         log.info("dev cluster ready: master :%d + %d workers", self.master.port, n_workers)
 
     def stop(self) -> None:
         for w in self.workers:
             w.stop()
         self.master.stop()
+        if self._chaos_installed:
+            from distributed_sgd_tpu import chaos as chaos_mod
+
+            chaos_mod.uninstall()
 
     def __enter__(self) -> "DevCluster":
         return self

@@ -44,6 +44,7 @@ from distributed_sgd_tpu.parallel.mesh import make_mesh
 from distributed_sgd_tpu.parallel.sync import SyncEngine
 from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
 from distributed_sgd_tpu.rpc.service import (
+    RpcPolicy,
     WorkerStub,
     add_master_servicer,
     new_channel,
@@ -103,6 +104,110 @@ def _await_futures(futs, bytes_counter=None):
     return ok, failed
 
 
+class _LatencyEwma:
+    """Per-worker Gradient reply-latency EWMA (mean + mean absolute
+    deviation) feeding the quorum barrier's adaptive soft deadline
+    (docs/FAULT_TOLERANCE.md).
+
+    `soft_deadline_s(keys, quorum)` answers "how long should the `quorum`
+    fastest workers need?": per worker a p95 proxy (mean + 3 * deviation),
+    then the quorum-th SMALLEST of those, with slack.  Taking a low order
+    statistic (not the max) is the point — a straggler's own tail must
+    not stretch the deadline that is supposed to cut it off.  Returns
+    None until at least `quorum` workers have history (the first windows
+    include compile latency and must run as full barriers)."""
+
+    SLACK = 1.5
+    FLOOR_S = 0.05
+
+    def __init__(self, alpha: float = 0.2):
+        self.alpha = float(alpha)
+        self._mean: Dict[Tuple[str, int], float] = {}
+        self._dev: Dict[Tuple[str, int], float] = {}
+        self._lock = threading.Lock()
+
+    def record(self, key: Tuple[str, int], seconds: float) -> None:
+        with self._lock:
+            m = self._mean.get(key)
+            if m is None:
+                self._mean[key] = seconds
+                self._dev[key] = 0.0
+                return
+            err = seconds - m
+            self._mean[key] = m + self.alpha * err
+            self._dev[key] = ((1 - self.alpha) * self._dev[key]
+                              + self.alpha * abs(err))
+
+    def p95_s(self, key: Tuple[str, int]) -> Optional[float]:
+        with self._lock:
+            m = self._mean.get(key)
+            if m is None:
+                return None
+            return m + 3.0 * self._dev[key]
+
+    def soft_deadline_s(self, keys, quorum: int) -> Optional[float]:
+        ests = sorted(e for e in (self.p95_s(k) for k in keys) if e is not None)
+        if len(ests) < max(1, quorum):
+            return None
+        return max(self.FLOOR_S, self.SLACK * ests[max(1, quorum) - 1])
+
+
+def _await_quorum(futs, quorum: int, soft_deadline: float,
+                  bytes_counter=None, latency: Optional[_LatencyEwma] = None):
+    """Quorum barrier over [(key, future-or-None)] (docs/FAULT_TOLERANCE.md).
+
+    Waits until every future settles, or until `soft_deadline` (absolute
+    time.monotonic) passes with at least `quorum` successful replies in
+    hand.  Returns (ok, failed, pending): ok/failed as _await_futures,
+    pending = [(key, future)] still in flight — the caller decides
+    whether to hedge their slices, keep waiting, or discard them (late
+    settles are idempotent: nobody reads an abandoned future).  Reply
+    bytes and per-worker latencies are accounted as replies ARRIVE, so
+    discarded stragglers still feed the EWMA that adapts the deadline."""
+    cv = threading.Condition()
+
+    def _notify(_):
+        with cv:
+            cv.notify()
+
+    t_sent = time.monotonic()
+    ok, failed, pending = [], [], []
+    for key, fut in futs:
+        if fut is None:
+            failed.append((key, ValueError("channel closed")))
+        else:
+            pending.append((key, fut))
+            fut.add_done_callback(_notify)
+    while pending:
+        still = []
+        for key, fut in pending:
+            if not fut.done():
+                still.append((key, fut))
+                continue
+            try:
+                reply = fut.result()
+                if bytes_counter is not None:
+                    bytes_counter.increment(reply.ByteSize())
+                if latency is not None:
+                    latency.record(key, time.monotonic() - t_sent)
+                ok.append((key, reply))
+            except grpc.RpcError as e:
+                failed.append((key, e.code()))
+        pending = still
+        if not pending:
+            break
+        now = time.monotonic()
+        remaining = soft_deadline - now
+        if remaining <= 0 and len(ok) >= quorum:
+            break
+        with cv:
+            # past the soft deadline but below quorum: keep waiting (the
+            # per-call gRPC deadline is the hard bound), waking on settles
+            cv.wait(timeout=0.25 if remaining <= 0
+                    else max(0.005, min(0.25, remaining)))
+    return ok, failed, pending
+
+
 def _draw_ids(rng: np.random.Generator, part: np.ndarray, start: int,
               size: int) -> np.ndarray:
     """Uniform without-replacement draw of up to `size` sample ids from one
@@ -147,15 +252,22 @@ class _BroadcastState:
 
     SPARSE_BREAK_EVEN = 0.5  # changed fraction above which dense is smaller
 
-    def __init__(self, delta_broadcast: bool, metrics):
+    def __init__(self, delta_broadcast: bool, metrics, versioned: bool = False):
         self.delta_broadcast = delta_broadcast
         self.metrics = metrics
+        # `versioned` without delta_broadcast (the quorum barrier's mode):
+        # every request still carries the full dense tensor, but stamped
+        # with step_version — the workers' EF guard and the quorum
+        # contribution mask (GradientRequest.ef_rollback_version) both key
+        # on the version, so quorum + compression is correct on the
+        # otherwise-unpipelined wire too
+        self.versioned = bool(delta_broadcast or versioned)
         # versions start at 1: step_version=0 on the wire means "no version
         # tracking" (a pre-pipeline master), and the workers' EF retry
         # guard keys on the version alone whenever one is present — a
         # retried window may switch wire form (full -> header-only) while
         # keeping its version, so the version must never be ambiguous
-        self.version = 1 if delta_broadcast else 0
+        self.version = 1 if self.versioned else 0
         self._worker_ver: Dict[Tuple[str, int], int] = {}
         self._w_prev: Optional[np.ndarray] = None
         self._full_msg = None     # encoded lazily, once per version
@@ -187,6 +299,8 @@ class _BroadcastState:
         if not self.delta_broadcast:
             full = self._full(w)
             req.weights.CopyFrom(full)
+            if self.versioned:
+                req.step_version = self.version
             metrics_mod.record_broadcast(self.metrics, "full", full.ByteSize())
             return
         req.step_version = self.version
@@ -238,10 +352,21 @@ class MasterNode:
         expected_workers: int,
         seed: int = 0,
         metrics: Optional[metrics_mod.Metrics] = None,
+        rpc_policy: Optional[RpcPolicy] = None,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=True)
         self.metrics = metrics or metrics_mod.global_metrics()
+        # unified control-plane RPC policy (deadline / backoff / breaker)
+        # replacing the scattered hardcoded timeout=5.0 calls
+        self.rpc_policy = rpc_policy or RpcPolicy(seed=seed,
+                                                  metrics=self.metrics)
+        # per-worker reply latency EWMAs: feed the quorum barriers'
+        # adaptive soft deadlines (fit_sync / predict quorum params).
+        # Gradient and Forward latencies differ by an order of magnitude,
+        # so each fan-out keeps its own tracker
+        self._latency = _LatencyEwma()
+        self._fwd_latency = _LatencyEwma()
         self.model = model
         self.train = train
         self.test = test
@@ -293,13 +418,18 @@ class MasterNode:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self, heartbeat_s: Optional[float] = None) -> "MasterNode":
+    def start(self, heartbeat_s: Optional[float] = None,
+              heartbeat_max_misses: int = 3) -> "MasterNode":
+        """`heartbeat_max_misses` (DSGD_HEARTBEAT_MAX_MISSES) is the
+        consecutive-miss eviction threshold — 3 keeps the historical
+        hardcoded default."""
         self.server.start()
         self.log.info("master started on %s:%d, expecting %d workers",
                       self.host, self.port, self.expected_workers)
         if heartbeat_s:
             self._hb_thread = threading.Thread(
-                target=self._heartbeat_loop, args=(heartbeat_s,),
+                target=self._heartbeat_loop,
+                args=(heartbeat_s, max(1, int(heartbeat_max_misses))),
                 daemon=True, name="heartbeat",
             )
             self._hb_thread.start()
@@ -307,13 +437,17 @@ class MasterNode:
 
     def _heartbeat_loop(self, interval_s: float, max_failures: int = 3) -> None:
         tracker = _FailureTracker(max_failures)
+        # probe deadline: the interval, capped by the policy deadline so a
+        # long interval doesn't grant a wedged peer a long blocking probe
+        probe_timeout = min(interval_s, self.rpc_policy.deadline_s)
         while not self._hb_stop.wait(interval_s):
             members = self._members()
             # probe concurrently so one dead worker costs one timeout, not D
             futs = []
             for key, stub in members:
                 try:
-                    futs.append((key, stub.Ping.future(pb.Empty(), timeout=interval_s)))
+                    futs.append((key, stub.Ping.future(pb.Empty(),
+                                                       timeout=probe_timeout)))
                 except ValueError:  # channel closed under us (unregister/stop)
                     futs.append((key, None))
             ok, failed = _await_futures(futs)
@@ -360,7 +494,7 @@ class MasterNode:
             if len(self._workers) >= self.expected_workers:
                 raise ValueError("cluster already at expected node count")
             others = list(self._workers.keys())
-            ch = new_channel(host, port)
+            ch = new_channel(host, port, origin=(self.host, self.port))
             stub = WorkerStub(ch)
             self._workers[key] = stub
             self._channels[key] = ch
@@ -372,8 +506,14 @@ class MasterNode:
         new_node = pb.Node(host=host, port=port)
         for oh, op in others:
             try:
-                self._workers[(oh, op)].RegisterSlave(new_node, timeout=5.0)
-                stub.RegisterSlave(pb.Node(host=oh, port=op), timeout=5.0)
+                # full policy (deadline + one jittered retry + breaker): a
+                # transient blip must not silently cost the mesh an edge
+                self.rpc_policy.call_with_retry(
+                    self._workers[(oh, op)].RegisterSlave, new_node,
+                    peer=(oh, op), retries=1)
+                self.rpc_policy.call_with_retry(
+                    stub.RegisterSlave, pb.Node(host=oh, port=op),
+                    peer=key, retries=1)
             except grpc.RpcError as e:
                 self.log.warning("peer introduction failed for %s:%d (%s)", oh, op, e.code())
         if count >= self.expected_workers:
@@ -392,7 +532,7 @@ class MasterNode:
         node = pb.Node(host=host, port=port)
         for stub in remaining:  # broadcast (Master.scala:245-253)
             try:
-                stub.UnregisterSlave(node, timeout=5.0)
+                stub.UnregisterSlave(node, timeout=self.rpc_policy.deadline_s)
             except grpc.RpcError:
                 pass
         self.log.info("worker unregistered: %s:%d", host, port)
@@ -413,6 +553,8 @@ class MasterNode:
         timeout_s: float = 60.0,
         retries: int = 1,
         return_margins: bool = False,
+        quorum: Optional[int] = None,
+        straggler_soft_s: Optional[float] = None,
     ):
         """Fan ForwardRequests out to every worker; gather predictions
         (and, with `return_margins`, the raw x.w margins — exact input for
@@ -422,6 +564,16 @@ class MasterNode:
         consecutive failures evict the worker, and the fan-out is retried
         across the survivors with a fresh split.  Raises RuntimeError if
         every worker is lost.
+
+        With `quorum` set the barrier grows straggler hedging
+        (docs/FAULT_TOLERANCE.md): once Q replies are in hand and the soft
+        deadline (`straggler_soft_s`, or adaptive from the Forward
+        latency EWMA) fires, each missing worker's sample slice is
+        re-issued to the fastest responders.  Unlike fit_sync's quorum,
+        evaluation NEVER drops a slice — predictions for every sample are
+        required — so quorum here only bounds how long a straggler can
+        hold the fan-out hostage before its slice is recomputed elsewhere;
+        an uncoverable slice falls back to the classic retry/evict loop.
         """
         self._require_ready()
         wmsg = codec.encode_tensor(weights)
@@ -431,6 +583,7 @@ class MasterNode:
             if not members:
                 raise RuntimeError("all workers lost during predict")
             parts = split(len(self.train), len(members))
+            part_by_key = {key: ids for (key, _), ids in zip(members, parts)}
             futs = []
             for (key, stub), ids in zip(members, parts):
                 try:
@@ -444,11 +597,17 @@ class MasterNode:
                 except ValueError:
                     fut = None
                 futs.append((key, fut))
-            ok, failed = _await_futures(futs)
+            if quorum is None:
+                ok, failed = _await_futures(futs)
+            else:
+                ok, failed = self._forward_quorum(
+                    futs, members, part_by_key, quorum, straggler_soft_s,
+                    timeout_s, wmsg, return_margins)
             if not failed:
                 out = np.zeros(len(self.train), dtype=np.float32)
                 margins = np.zeros(len(self.train), dtype=np.float32)
-                for ids, (_, reply) in zip(parts, ok):
+                for key, reply in ok:
+                    ids = part_by_key[key]
                     out[ids] = np.fromiter(reply.predictions, dtype=np.float32)
                     if return_margins:
                         if len(reply.margins) != len(ids):
@@ -469,6 +628,79 @@ class MasterNode:
                 else:
                     self.log.warning("worker %s:%d failed Forward (%s); retry %d/%d",
                                      key[0], key[1], code, n, retries)
+
+    def _forward_quorum(self, futs, members, part_by_key, quorum,
+                        straggler_soft_s, timeout_s, wmsg, want_margins):
+        """Quorum-gated Forward barrier with straggler hedging (see
+        predict).  Returns (ok, failed) with every entry keyed by the
+        SLICE's worker key — a winning hedge reply is recorded under the
+        straggler's key, so the caller's slice-addressed assembly and the
+        failure tracker both stay oblivious to who actually computed it."""
+        quorum_n = min(quorum, len(members))
+        soft_s = straggler_soft_s
+        if soft_s is None:
+            soft_s = self._fwd_latency.soft_deadline_s(
+                part_by_key.keys(), quorum_n)
+        soft_s = min(soft_s, timeout_s) if soft_s else timeout_s
+        ok, failed, pending = _await_quorum(
+            futs, quorum_n, time.monotonic() + soft_s,
+            latency=self._fwd_latency)
+        uncovered = [k for k, _ in pending] + [k for k, _ in failed]
+        if uncovered and len(ok) >= quorum_n:
+            stub_by_key = dict(members)
+            donors = sorted(
+                (k for k, _ in ok),
+                key=lambda k: self._fwd_latency.p95_s(k) or float("inf"))
+            hedges = []
+            for i, skey in enumerate(uncovered):
+                donor = donors[i % len(donors)]
+                try:
+                    hfut = stub_by_key[donor].Forward.future(
+                        pb.ForwardRequest(
+                            samples=part_by_key[skey].astype(np.int32),
+                            weights=wmsg, want_margins=want_margins),
+                        timeout=min(timeout_s, 2.0 * soft_s))
+                except ValueError:
+                    continue
+                hedges.append((skey, hfut))
+                self.metrics.counter(metrics_mod.QUORUM_HEDGES).increment()
+                self.log.info("hedging Forward slice of straggler %s:%d "
+                              "on %s:%d", *skey, *donor)
+            h_ok, _h_failed = _await_futures(hedges)
+            still = []
+            for key, fut in pending:  # late originals are preferred
+                if not fut.done():
+                    still.append((key, fut))
+                    continue
+                try:
+                    ok.append((key, fut.result()))
+                except grpc.RpcError as e:
+                    failed.append((key, e.code()))
+            pending = still
+            covered = {k for k, _ in ok}
+            for skey, reply in h_ok:
+                if skey not in covered:
+                    ok.append((skey, reply))
+                    covered.add(skey)
+                    self.metrics.counter(
+                        metrics_mod.QUORUM_HEDGE_WINS).increment()
+        elif pending:
+            # below quorum: wait the hard deadline out, classic barrier
+            ok2, failed2, _ = _await_quorum(
+                pending, len(pending) + 1,
+                time.monotonic() + timeout_s + 5.0,
+                latency=self._fwd_latency)
+            ok.extend(ok2)
+            failed.extend(failed2)
+            pending = []
+        covered = {k for k, _ in ok}
+        # an uncoverable slice (straggler past soft + hedge deadlines, or
+        # its hedge failed too) joins the classic retry/evict path
+        failed = [(k, c) for k, c in failed if k not in covered]
+        for key, fut in pending:
+            if key not in covered:
+                failed.append((key, grpc.StatusCode.DEADLINE_EXCEEDED))
+        return ok, failed
 
     def distributed_loss(self, weights: np.ndarray) -> float:
         """Objective from the Forward fan-out (Master.scala:77-98).
@@ -526,6 +758,9 @@ class MasterNode:
         momentum: float = 0.9,
         local_steps: int = 1,
         delta_broadcast: bool = False,
+        quorum: Optional[int] = None,
+        straggler_soft_s: Optional[float] = None,
+        hedge: bool = True,
     ) -> FitResult:
         """Fault-tolerant sync fit, with an optional pipelined wire path.
 
@@ -568,9 +803,33 @@ class MasterNode:
           pseudo-gradient (mean_delta / learning_rate) through the same
           optimizer surface — K x fewer barriers and broadcasts per epoch,
           local-SGD semantics (Stich, 2018) between them.
+
+        Quorum barrier (DSGD_QUORUM, docs/FAULT_TOLERANCE.md; Chen et al.
+        2016's N+b backup-replica shape): with `quorum=Q` the window
+        barrier returns once all replies land OR once a soft deadline
+        (`straggler_soft_s`, or p95-adaptive from each worker's reply
+        latency EWMA when unset) fires with >= Q usable replies in hand.
+        The master then hedges each missing worker's data slice to the
+        fastest responders (`hedge=True`), prefers a straggler's own reply
+        if it lands during the hedge window, averages over the actual
+        contributors (unbiased 1/|ok| scaling), discards late replies
+        idempotently via the (fit_token, step_version) window keys, and
+        tells each non-contributing worker to roll back its error-feedback
+        residual drain (GradientRequest.ef_rollback_version).  Below
+        quorum the window degrades to today's full barrier + retry, and a
+        quorum-satisfied round never counts toward eviction — a straggler
+        is slow, not dead (run the heartbeat for liveness).  Default
+        `quorum=None` keeps the barrier, wire, and call graph identical
+        to the pre-quorum engine.
         """
         if on_worker_death not in ("resplit", "fail"):
             raise ValueError(f"on_worker_death must be resplit|fail, got {on_worker_death!r}")
+        if quorum is not None and int(quorum) < 1:
+            raise ValueError(f"quorum must be >= 1, got {quorum}")
+        quorum = int(quorum) if quorum is not None else None
+        if straggler_soft_s is not None and straggler_soft_s <= 0:
+            raise ValueError(
+                f"straggler_soft_s must be > 0, got {straggler_soft_s}")
         local_steps = max(1, int(local_steps))
         self._require_ready()
         members = self._members()
@@ -587,13 +846,22 @@ class MasterNode:
         tracker = _FailureTracker(grad_retries + 1)
         self._fit_seq += 1
         fit_token = self._fit_token_base + self._fit_seq
-        bcast = _BroadcastState(delta_broadcast, self.metrics)
+        # quorum forces version stamping even on the plain full-tensor
+        # wire: the EF rollback mask keys on step_version
+        bcast = _BroadcastState(delta_broadcast, self.metrics,
+                                versioned=quorum is not None)
         # allocation-free fan-in: one dim-sized accumulator reused by every
         # window instead of a (workers x dim) dense stack per barrier
         grad_acc = np.zeros(self.model.n_features, dtype=np.float32)
         grad_bytes = self.metrics.counter(metrics_mod.SYNC_GRAD_BYTES)
         rounds = self.metrics.counter(metrics_mod.SYNC_ROUNDS)
         window_span = batch_size * local_steps
+        # quorum bookkeeping (all inert when quorum is None):
+        # ef_rollback[worker] = broadcast version whose reply the quorum
+        # barrier discarded — the NEXT request to that worker carries it so
+        # the worker rolls back its EF residual drain for the skipped round
+        ef_rollback: Dict[Tuple[str, int], int] = {}
+        stalled = self.metrics.counter(metrics_mod.SYNC_STALLED)
 
         from distributed_sgd_tpu.checkpoint import opt_kind_tag
         from distributed_sgd_tpu.parallel.sync import resolve_optimizer
@@ -655,34 +923,60 @@ class MasterNode:
                         break
                 t_batch = time.perf_counter()
                 futs = []
+                ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
+                rb_sent: Dict[Tuple[str, int], int] = {}
                 for (key, stub), part in zip(members, parts):
                     ids = _draw_ids(rng, part, batch, window_span)
+                    ids_by_key[key] = ids
                     req = pb.GradientRequest(
                         samples=ids.astype(np.int32), fit_token=fit_token)
                     if local_steps > 1:
                         req.local_steps = local_steps
                         req.batch_size = batch_size
                         req.learning_rate = learning_rate
+                    rb = ef_rollback.pop(key, None)
+                    if rb is not None:
+                        req.ef_rollback_version = rb
+                        rb_sent[key] = rb  # re-armed if this request fails
                     bcast.populate(req, key, w)
                     try:
                         fut = stub.Gradient.future(req, timeout=grad_timeout_s)
                     except ValueError:  # channel closed under us
                         fut = None
                     futs.append((key, fut))
-                # barrier, with deadlines; receive-side wire accounting
-                # happens per arriving reply inside _await_futures (send-
-                # side comms.* counters live in the workers' compressors),
-                # so discarded/retried windows are accounted too
-                ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
+                if quorum is None:
+                    # barrier, with deadlines; receive-side wire accounting
+                    # happens per arriving reply inside _await_futures (send-
+                    # side comms.* counters live in the workers' compressors),
+                    # so discarded/retried windows are accounted too
+                    ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
+                    good, stale = [], []
+                    for key, reply in ok:
+                        (stale if reply.stale_version else good).append((key, reply))
+                    replies = [r for _, r in good]
+                    satisfied = False
+                    # pure observation when a soft deadline is configured
+                    # without quorum: how often would the quorum barrier
+                    # have had to intervene?  (bench_chaos.py's baseline)
+                    if (straggler_soft_s is not None
+                            and time.perf_counter() - t_batch > straggler_soft_s):
+                        stalled.increment()
+                else:
+                    replies, good, stale, failed, satisfied = (
+                        self._quorum_barrier(
+                            futs, members, ids_by_key, quorum,
+                            straggler_soft_s, grad_timeout_s, fit_token,
+                            local_steps, batch_size, learning_rate, bcast,
+                            w, hedge, ef_rollback, grad_bytes, rb_sent))
                 rounds.increment()
-                for key, _ in ok:
-                    tracker.record_ok(key)
-                good, stale = [], []
-                for key, reply in ok:
-                    (stale if reply.stale_version else good).append((key, reply))
                 for key, _ in good:
+                    tracker.record_ok(key)
                     bcast.note_ok(key)
                 for key, _ in stale:
+                    # a stale reply is still a LIVE worker: reset its
+                    # failure count (the pre-quorum code treated every ok
+                    # reply as liveness evidence)
+                    tracker.record_ok(key)
                     # replica mismatch (restart, missed window): full
                     # broadcast on the retry — the correctness fallback
                     bcast.note_stale(key)
@@ -690,34 +984,38 @@ class MasterNode:
                     self.log.warning(
                         "worker %s:%d replica stale at v%d; falling back to "
                         "full broadcast", key[0], key[1], bcast.version)
-                if failed:
-                    for key, code in failed:
-                        n, evict = tracker.record_failure(key)
-                        if not evict:
+                if not satisfied:
+                    if failed:
+                        for key, code in failed:
+                            n, evict = tracker.record_failure(key)
+                            if not evict:
+                                self.log.warning(
+                                    "worker %s:%d failed Gradient (%s); retry %d/%d",
+                                    key[0], key[1], code, n, grad_retries)
+                                continue
+                            if on_worker_death == "fail":
+                                # abort WITHOUT mutating membership: the caller
+                                # chose to investigate, not to continue degraded
+                                raise RuntimeError(
+                                    f"worker {key[0]}:{key[1]} died mid-fit "
+                                    f"({n} consecutive Gradient failures: {code})")
                             self.log.warning(
-                                "worker %s:%d failed Gradient (%s); retry %d/%d",
-                                key[0], key[1], code, n, grad_retries)
-                            continue
-                        if on_worker_death == "fail":
-                            # abort WITHOUT mutating membership: the caller
-                            # chose to investigate, not to continue degraded
-                            raise RuntimeError(
-                                f"worker {key[0]}:{key[1]} died mid-fit "
-                                f"({n} consecutive Gradient failures: {code})")
-                        self.log.warning(
-                            "worker %s:%d failed Gradient %d times (%s); declaring dead",
-                            key[0], key[1], n, code)
-                        self.unregister_worker(*key)
-                if failed or stale:
-                    continue  # retry this window (survivors or re-split)
+                                "worker %s:%d failed Gradient %d times (%s); declaring dead",
+                                key[0], key[1], n, code)
+                            self.unregister_worker(*key)
+                    if failed or stale:
+                        continue  # retry this window (survivors or re-split)
                 # allocation-free fan-in: scatter/add every reply into the
                 # preallocated accumulator, then scale once — replaces the
                 # per-window [decode_grad(r) for r in ok] dense stack +
-                # np.mean (Vec.mean, Master.scala:194)
+                # np.mean (Vec.mean, Master.scala:194).  Under a satisfied
+                # quorum `replies` holds the actual contributors (own + hedge
+                # replies) and the mean over |contributors| is the unbiased
+                # 1/|ok| scaling of Chen et al. 2016's backup-worker rule.
                 grad_acc.fill(0.0)
-                for _, reply in good:
+                for reply in replies:
                     codec.decode_grad_into(reply, grad_acc)
-                grad_acc /= len(good)  # true divide, bit-matching np.mean
+                grad_acc /= len(replies)  # true divide, bit-matching np.mean
                 w_old = w
                 if local_steps > 1:
                     # replies are summed weight-space decrements; apply the
@@ -772,7 +1070,174 @@ class MasterNode:
         ).finish()
         return result
 
-    # -- async fit (MasterAsync.scala:32-162) ------------------------------
+    def _quorum_barrier(self, futs, members, ids_by_key, quorum,
+                        straggler_soft_s, grad_timeout_s, fit_token,
+                        local_steps, batch_size, learning_rate, bcast, w,
+                        hedge, ef_rollback, grad_bytes, rb_sent):
+        """One window's quorum barrier + straggler hedging
+        (docs/FAULT_TOLERANCE.md).
+
+        Returns (replies, good, stale, failed, satisfied):
+
+        - satisfied=True — the round closes NOW with `replies` (>= quorum
+          GradUpdates: workers' own replies plus hedge replies covering
+          straggler slices).  `good` lists the workers whose OWN reply was
+          used (liveness + broadcast-version bookkeeping); stragglers'
+          discarded windows are marked in `ef_rollback` and their late
+          replies are counted (idempotently dropped — nobody reads an
+          abandoned future).  No failure is recorded for a missing
+          straggler: slow is not dead (heartbeat owns liveness).
+        - satisfied=False — quorum could not be met at the soft deadline:
+          everything was awaited to the hard (per-call) deadline and the
+          caller runs the classic full-barrier failure/stale/retry path
+          over (good, stale, failed) unchanged.
+        """
+        quorum_n = min(quorum, len(members))
+        soft_s = straggler_soft_s
+        if soft_s is None:
+            # p95-adaptive from the per-worker reply-latency EWMA; until
+            # it warms (>= quorum workers with history) the window runs as
+            # a full barrier, which is what seeds the EWMA
+            soft_s = self._latency.soft_deadline_s(ids_by_key.keys(), quorum_n)
+        soft_s = min(soft_s, grad_timeout_s) if soft_s else grad_timeout_s
+        t0 = time.monotonic()
+        ok, failed, pending = _await_quorum(
+            futs, quorum_n, t0 + soft_s,
+            bytes_counter=grad_bytes, latency=self._latency)
+        # a stalled round is one the quorum could NOT relieve: the barrier
+        # physically overran the soft deadline because fewer than Q usable
+        # replies were in hand when it fired (a quorum-relieved round exits
+        # within a poll quantum of the deadline).  bench_chaos.py's >= 3x
+        # headline counts exactly these.
+        if time.monotonic() - t0 > soft_s + max(0.05, 0.25 * soft_s):
+            self.metrics.counter(metrics_mod.SYNC_STALLED).increment()
+        good, stale = [], []
+        for key, reply in ok:
+            (stale if reply.stale_version else good).append((key, reply))
+
+        uncovered = ([k for k, _ in pending] + [k for k, _ in failed]
+                     + [k for k, _ in stale])
+        hedge_futs = []
+        if uncovered and len(good) >= quorum_n and hedge and good:
+            # hedge each missing slice on the fastest responders: a
+            # duplicate Gradient over the straggler's drawn ids, weights
+            # populated for the donor (header-only under delta broadcast —
+            # the donor just acknowledged this version)
+            donors = sorted(
+                (k for k, _ in good),
+                key=lambda k: self._latency.p95_s(k) or float("inf"))
+            stub_by_key = dict(members)
+            hedge_deadline = min(grad_timeout_s, 2.0 * soft_s)
+            for i, skey in enumerate(uncovered):
+                donor = donors[i % len(donors)]
+                hreq = pb.GradientRequest(
+                    samples=ids_by_key[skey].astype(np.int32),
+                    fit_token=fit_token, hedge=True)
+                if local_steps > 1:
+                    hreq.local_steps = local_steps
+                    hreq.batch_size = batch_size
+                    hreq.learning_rate = learning_rate
+                bcast.note_ok(donor)  # its own reply proved this version
+                bcast.populate(hreq, donor, w)
+                try:
+                    hfut = stub_by_key[donor].Gradient.future(
+                        hreq, timeout=hedge_deadline)
+                except ValueError:
+                    continue
+                hedge_futs.append((skey, hfut))
+                self.metrics.counter(metrics_mod.QUORUM_HEDGES).increment()
+                self.log.info(
+                    "hedging slice of straggler %s:%d on %s:%d", *skey, *donor)
+            h_ok, _h_failed = _await_futures(hedge_futs,
+                                             bytes_counter=grad_bytes)
+        else:
+            h_ok = []
+
+        # harvest originals that landed while the hedges ran — a
+        # straggler's OWN reply is always preferred over its hedge (its
+        # EF drain was real, and preferring it keeps the residual exact)
+        still_pending = []
+        for key, fut in pending:
+            if not fut.done():
+                still_pending.append((key, fut))
+                continue
+            try:
+                reply = fut.result()
+                grad_bytes.increment(reply.ByteSize())
+                self._latency.record(key, soft_s)  # at least the soft window
+                (stale if reply.stale_version else good).append((key, reply))
+            except grpc.RpcError as e:
+                failed.append((key, e.code()))
+
+        own = {k for k, _ in good}
+        # a slice covered by BOTH its own late original and its hedge
+        # contributes exactly once — the original wins, the hedge is waste
+        hedge_wins = [
+            (skey, r) for skey, r in h_ok
+            if skey not in own and not r.stale_version]
+        # canonical slice order: float accumulation is order-sensitive, so
+        # contributions are summed in fan-out order regardless of arrival
+        # order — a quorum round with every reply in hand is bit-identical
+        # to the plain barrier
+        order = {key: i for i, key in enumerate(ids_by_key)}
+        good.sort(key=lambda kr: order[kr[0]])
+        replies = [r for _, r in
+                   sorted(good + hedge_wins, key=lambda kr: order[kr[0]])]
+        if len(replies) >= quorum_n:
+            if len(good) < len(ids_by_key):
+                self.metrics.counter(metrics_mod.QUORUM_DEGRADED).increment()
+            for _ in hedge_wins:
+                self.metrics.counter(metrics_mod.QUORUM_HEDGE_WINS).increment()
+            # contribution mask: every fanned-out worker whose own reply
+            # was NOT used rolls its EF drain back on the next request
+            # (exact-match on the broadcast version, so a worker that
+            # never received this window simply ignores it).  A request
+            # that failed outright may never have been processed — a
+            # rollback marker it carried is still owed, so re-arm the OLD
+            # marker for those (exact-match keeps either choice safe; this
+            # picks the one a never-delivered request leaves true).
+            late_counter = self.metrics.counter(metrics_mod.QUORUM_LATE)
+            failed_keys = {k for k, _ in failed}
+            for key in ids_by_key:
+                if key not in own:
+                    if key in failed_keys and key in rb_sent:
+                        ef_rollback[key] = rb_sent[key]
+                    else:
+                        ef_rollback[key] = bcast.version
+            for key, fut in still_pending:
+                def _count_late(f, _c=late_counter):
+                    if not f.cancelled():
+                        _c.increment()
+                fut.add_done_callback(_count_late)
+            # stragglers are NOT failures: no tracker/eviction pressure
+            # from a quorum-satisfied round
+            return replies, good, stale, [], True
+
+        # below quorum: classic full barrier — await the hard deadline,
+        # then hand the classic failure/stale/retry path the full picture
+        # (the stall, if any, was already counted by the overrun check)
+        if still_pending:
+            ok2, failed2, _ = _await_quorum(
+                still_pending, len(still_pending) + 1,
+                time.monotonic() + grad_timeout_s + 5.0,
+                bytes_counter=grad_bytes, latency=self._latency)
+            for key, reply in ok2:
+                (stale if reply.stale_version else good).append((key, reply))
+            failed.extend(failed2)
+        # hedge replies are dropped below quorum: the classic retry path
+        # averages over the member fan-out only (and hedges were only sent
+        # if quorum had been met when the soft deadline fired).  Fan-out
+        # order again, for bit-identity with the plain barrier.  Rollback
+        # markers whose carrying request yielded no usable reply are
+        # re-armed for the retry (a worker that DID process the request
+        # consumed its marker, making the repeat an exact-match no-op).
+        order = {key: i for i, key in enumerate(ids_by_key)}
+        good.sort(key=lambda kr: order[kr[0]])
+        own = {k for k, _ in good}
+        for key, rb in rb_sent.items():
+            if key not in own:
+                ef_rollback.setdefault(key, rb)
+        return [r for _, r in good], good, stale, failed, False
 
     def fit_async(
         self,
@@ -943,16 +1408,17 @@ class MasterNode:
         just refuses the connection)."""
         self._async_running.clear()
         self._async_done.set()
+        deadline = self.rpc_policy.deadline_s
         for key in endpoints:
             with self._members_lock:
                 stub = self._workers.get(key)
             try:
                 if stub is not None:
-                    stub.StopAsync(pb.Empty(), timeout=5.0)
+                    stub.StopAsync(pb.Empty(), timeout=deadline)
                 else:
-                    ch = new_channel(*key)
+                    ch = new_channel(*key, origin=(self.host, self.port))
                     try:
-                        WorkerStub(ch).StopAsync(pb.Empty(), timeout=5.0)
+                        WorkerStub(ch).StopAsync(pb.Empty(), timeout=deadline)
                     finally:
                         ch.close()
             except (grpc.RpcError, ValueError):
@@ -1005,7 +1471,7 @@ class MasterNode:
             try:
                 if stub is None:
                     raise ValueError("channel closed")
-                stub.Ping(pb.Empty(), timeout=5.0)
+                stub.Ping(pb.Empty(), timeout=self.rpc_policy.deadline_s)
             except (grpc.RpcError, ValueError) as e:
                 code = e.code() if isinstance(e, grpc.RpcError) else e
                 self.log.warning(

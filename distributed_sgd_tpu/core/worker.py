@@ -32,6 +32,7 @@ from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
 from distributed_sgd_tpu.rpc.service import (
     GossipSender,
     MasterStub,
+    RpcPolicy,
     WorkerStub,
     add_worker_servicer,
     new_channel,
@@ -40,8 +41,10 @@ from distributed_sgd_tpu.rpc.service import (
 from distributed_sgd_tpu.utils import metrics as metrics_mod
 from distributed_sgd_tpu.utils.log import node_logger
 
-REGISTER_RETRY_S = 2.0  # Slave.scala:56
-REGISTER_DEADLINE_S = 5.0  # Slave.scala:48
+# registration timing now lives in RpcPolicy (rpc/service.py): the policy
+# defaults keep the reference's 5 s call deadline (Slave.scala:48) and 2 s
+# initial retry delay (Slave.scala:56), growing with jittered exponential
+# backoff to a ~30 s cap instead of a fixed 2 s sleep forever
 
 
 def _next_pow2(n: int) -> int:
@@ -65,10 +68,15 @@ class WorkerNode:
         compress: str = "none",
         compress_k: float = 0.01,
         compress_ef: bool = True,
+        rpc_policy: Optional[RpcPolicy] = None,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
         self.metrics = metrics or metrics_mod.global_metrics()
+        # unified retry/backoff/breaker policy for every outgoing RPC
+        # (registration backoff, gossip breaker suppression)
+        self.rpc_policy = rpc_policy or RpcPolicy(
+            seed=seed + port, metrics=self.metrics)
         self.model = model
         self.device = device if device is not None else jax.devices()[0]
         self.seed = seed
@@ -86,7 +94,13 @@ class WorkerNode:
         # the last Gradient request, plus the fit-session token last seen —
         # see encode_sync_grad.  The key is the broadcast step_version
         # under the versioned wire (retries repeat it even when the wire
-        # form changes), the raw weight bytes under the pre-pipeline wire
+        # form changes), the raw weight bytes under the pre-pipeline wire.
+        # The lock exists for the quorum barrier (DSGD_QUORUM): a straggler
+        # can still be encoding window v when the master's request for v+1
+        # (possibly carrying an ef_rollback_version) arrives on another
+        # servicer thread — without quorum exactly one Gradient is ever in
+        # flight per worker and the lock is uncontended
+        self._sync_guard_lock = threading.Lock()
         self._sync_ef_guard: Tuple[Optional[object], Optional[np.ndarray]] = (
             None, None)
         self._sync_fit_token = 0
@@ -118,10 +132,18 @@ class WorkerNode:
         self._gossip: Dict[Tuple[str, int], GossipSender] = {}
         self._max_inflight_gossip = int(max_inflight_gossip)
         self._peers_lock = threading.Lock()
-        self._master_channel = new_channel(master_host, master_port)
+        # server first: port 0 resolves to the bound port HERE, so the
+        # outgoing channels below carry the worker's real endpoint as their
+        # chaos edge origin
+        self.server = new_server(port, host="0.0.0.0")
+        self.port = self.port or self.server.bound_port
+        self._master_channel = new_channel(master_host, master_port,
+                                           origin=(host, self.port))
         self._master = MasterStub(self._master_channel)
         self._master_gossip = GossipSender(
-            self._master.UpdateGrad, self.metrics, self._max_inflight_gossip)
+            self._master.UpdateGrad, self.metrics, self._max_inflight_gossip,
+            breaker=self.rpc_policy.breaker((master_host, master_port)),
+            deadline_s=self.rpc_policy.deadline_s)
 
         # async (Hogwild) state — Slave.scala:23-34
         self._w_lock = threading.Lock()
@@ -135,8 +157,6 @@ class WorkerNode:
         self._apply = jax.jit(lambda w, d: w - d)
         self._grad_cache: Dict[int, callable] = {}  # keyed by padded capacity
 
-        self.server = new_server(port, host="0.0.0.0")
-        self.port = self.port or self.server.bound_port
         add_worker_servicer(self.server, _WorkerServicer(self))
         self._registered = threading.Event()
         self._stopped = threading.Event()
@@ -154,16 +174,21 @@ class WorkerNode:
 
     def _register_loop(self) -> None:
         node = pb.Node(host=self.host, port=self.port)
+        attempt = 0
         while not self._stopped.is_set() and not self._registered.is_set():
             try:
-                self._master.RegisterSlave(node, timeout=REGISTER_DEADLINE_S)
+                self._master.RegisterSlave(node, timeout=self.rpc_policy.deadline_s)
                 self._registered.set()
                 self.log.info("registered with master")
                 return
             except grpc.RpcError as e:
-                self.log.info("registration failed (%s); retrying in %.0fs",
-                              e.code(), REGISTER_RETRY_S)
-                self._stopped.wait(REGISTER_RETRY_S)
+                # jittered exponential backoff (policy default: 2 s first
+                # delay, the reference's fixed retry period, Slave.scala:56)
+                delay = self.rpc_policy.backoff_s(attempt)
+                attempt += 1
+                self.log.info("registration failed (%s); retry %d in %.1fs",
+                              e.code(), attempt, delay)
+                self._stopped.wait(delay)
 
     def stop(self) -> None:
         self._stopped.set()
@@ -197,10 +222,18 @@ class WorkerNode:
             return
         with self._peers_lock:
             if key not in self._peers:
-                stub = WorkerStub(new_channel(host, port))
+                stub = WorkerStub(new_channel(host, port,
+                                              origin=(self.host, self.port)))
                 self._peers[key] = stub
+                # breaker-aware gossip: a partitioned peer costs one probe
+                # per cooldown, not max_inflight in-flight cancels.  A
+                # (re)introduction is evidence of liveness, so a breaker
+                # tripped by the peer's previous incarnation re-closes
+                breaker = self.rpc_policy.breaker(key)
+                breaker.record_ok()
                 self._gossip[key] = GossipSender(
-                    stub.UpdateGrad, self.metrics, self._max_inflight_gossip)
+                    stub.UpdateGrad, self.metrics, self._max_inflight_gossip,
+                    breaker=breaker, deadline_s=self.rpc_policy.deadline_s)
                 self.log.info("peer added: %s:%d", host, port)
 
     def remove_peer(self, host: str, port: int) -> None:
@@ -398,19 +431,42 @@ class WorkerNode:
         windows.  0 = an older master without session tracking: behave as
         before (residual carried, bounded by one window's unsent mass).
         """
-        if fit_token and fit_token != self._sync_fit_token:
-            self._sync_fit_token = fit_token
-            self._compressor.residual_drop("sync:master")
-            self._sync_ef_guard = (None, None)
-        prev_key, prev_res = self._sync_ef_guard
-        if prev_key is not None and prev_key == window_key:
-            self._compressor.residual_restore("sync:master", prev_res)
-        else:
-            self._sync_ef_guard = (
-                window_key,
-                self._compressor.residual_snapshot("sync:master"),
-            )
-        return self._compressor.compress(g, dest="sync:master")
+        with self._sync_guard_lock:
+            if fit_token and fit_token != self._sync_fit_token:
+                self._sync_fit_token = fit_token
+                self._compressor.residual_drop("sync:master")
+                self._sync_ef_guard = (None, None)
+            prev_key, prev_res = self._sync_ef_guard
+            if prev_key is not None and prev_key == window_key:
+                self._compressor.residual_restore("sync:master", prev_res)
+            else:
+                self._sync_ef_guard = (
+                    window_key,
+                    self._compressor.residual_snapshot("sync:master"),
+                )
+            return self._compressor.compress(g, dest="sync:master")
+
+    def rollback_sync_ef(self, version: int) -> None:
+        """Quorum contribution mask (GradientRequest.ef_rollback_version):
+        the master discarded this worker's reply for broadcast `version`
+        (the quorum barrier proceeded without it), so the residual drain
+        of that window must be rolled back — the round contributed
+        nothing, and its unsent top-k mass must neither be lost (drain)
+        nor ride a later message twice (the master never applied the
+        shipped part, so restoring the PRE-drain snapshot is exact).
+
+        Exact-match only: if the guard's window key is not `version` the
+        worker never encoded that window (the request itself was lost
+        before compute) and there is nothing to roll back — the
+        instruction is idempotent and safe to repeat."""
+        if self._compressor is None:
+            return
+        with self._sync_guard_lock:
+            prev_key, prev_res = self._sync_ef_guard
+            if prev_key is not None and prev_key == version:
+                self._compressor.residual_restore("sync:master", prev_res)
+                self._sync_ef_guard = (None, None)
+                self.metrics.counter("slave.sync.ef.rollback").increment()
 
     def compute_forward(self, w: np.ndarray, ids: np.ndarray):
         """Forward RPC body (Slave.scala:129-140) -> (predictions, margins).
@@ -597,6 +653,10 @@ class _WorkerServicer:
         return pb.ForwardReply(predictions=preds)
 
     def Gradient(self, request, context):  # noqa: N802
+        # quorum contribution mask: the master marks the window whose
+        # reply it discarded so the EF residual drain rolls back first
+        if request.ef_rollback_version:
+            self.w.rollback_sync_ef(request.ef_rollback_version)
         w, stale = self.w.resolve_request_weights(request)
         if stale:
             # replica/version mismatch: no gradient to give — the master
@@ -610,6 +670,17 @@ class _WorkerServicer:
                 w, ids, k, request.batch_size, request.learning_rate)
         else:
             g = self.w.compute_gradient(w, ids)
+        if request.hedge:
+            # straggler hedge (another worker's data slice): reply
+            # uncompressed and leave this worker's OWN sync EF residual
+            # untouched — the residual for that slice belongs to the
+            # straggler, and draining ours here would double-count mass
+            # against the master's average
+            self.w.metrics.counter("slave.sync.hedge").increment()
+            msg = codec.encode_grad(g)
+            if k > 1:
+                msg.n_steps = k
+            return msg
         # sync fan-in reply: compressed when configured (EF residual keyed
         # to the one sync destination — this worker answers one master),
         # with the retry-rollback + fit-session guards of encode_sync_grad

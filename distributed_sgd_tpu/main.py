@@ -163,6 +163,13 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             "DSGD_LOCAL_STEPS/DSGD_DELTA_BROADCAST ignored: the pipelined "
             "sync engine is the rpc topology's (use engine=rpc; the mesh "
             "local-SGD equivalent is async_mode=local_sgd / sync_period)")
+    if cfg.quorum is not None or cfg.chaos:
+        # quorum barriers gate RPC fan-ins and chaos wraps RPC stubs; an
+        # in-mesh XLA collective has neither
+        log.warning(
+            "DSGD_QUORUM/DSGD_CHAOS ignored: the quorum barrier and the "
+            "fault-injection layer live on the rpc topology's wire "
+            "(use engine=rpc)")
     log.info(
         "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
         n, virtual, cfg.kernel, cfg.model, cfg.use_async,
@@ -236,9 +243,11 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
 
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
     with DevCluster(model, train, test, n_workers=cfg.node_count, seed=cfg.seed,
+                    heartbeat_s=cfg.heartbeat_s,
+                    heartbeat_max_misses=cfg.heartbeat_max_misses,
                     steps_per_dispatch=cfg.steps_per_dispatch,
                     compress=cfg.compress, compress_k=cfg.compress_k,
-                    compress_ef=cfg.compress_ef) as c:
+                    compress_ef=cfg.compress_ef, chaos=cfg.chaos) as c:
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
@@ -257,6 +266,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 optimizer=cfg.optimizer, momentum=cfg.momentum,
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
+                quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
             )
         _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True),
                 saved=ckpt is not None)
@@ -322,6 +332,19 @@ def main() -> None:
             pusher.stop()
 
 
+def _install_chaos(cfg: Config) -> None:
+    """DSGD_CHAOS on a standalone master/worker process: install the plan
+    before any channel exists so every outgoing stub is wrapped (chaos/).
+    Partition specs reference endpoints as host:port in multi-process
+    deployments; dev mode's DevCluster also names them w0..wN/master."""
+    if not cfg.chaos:
+        return
+    from distributed_sgd_tpu import chaos
+
+    chaos.install(cfg.chaos, metrics=metrics_mod.global_metrics())
+    log.warning("chaos plan active on this node: %s", cfg.chaos)
+
+
 def _run_role(cfg: Config, role: str) -> None:
     if role == "serve":
         # Online inference front end (serving/; DSGD_ROLE=serve): no
@@ -352,11 +375,13 @@ def _run_role(cfg: Config, role: str) -> None:
     elif role == "master":
         from distributed_sgd_tpu.core.master import MasterNode
 
+        _install_chaos(cfg)
         train, test, model = build(cfg)
         master = MasterNode(
             cfg.host, cfg.port, train, test, model,
             expected_workers=cfg.node_count, seed=cfg.seed,
-        ).start(heartbeat_s=cfg.heartbeat_s)
+        ).start(heartbeat_s=cfg.heartbeat_s,
+                heartbeat_max_misses=cfg.heartbeat_max_misses)
         criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
         master.await_ready()
         ckpt = _make_checkpointer(cfg)
@@ -374,6 +399,7 @@ def _run_role(cfg: Config, role: str) -> None:
                 optimizer=cfg.optimizer, momentum=cfg.momentum,
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
+                quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
             )
         _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True),
                 saved=ckpt is not None)
@@ -381,6 +407,7 @@ def _run_role(cfg: Config, role: str) -> None:
     else:  # worker
         from distributed_sgd_tpu.core.worker import WorkerNode
 
+        _install_chaos(cfg)
         train, _, model = build(cfg)
         worker = WorkerNode(
             cfg.host, cfg.port, cfg.master_host, cfg.master_port, train, model,

@@ -9,12 +9,208 @@ server factories mirror core/package.scala:16-21 (plaintext).
 
 from __future__ import annotations
 
+import random
+import threading
+import time
 from concurrent import futures
-from typing import Optional
+from typing import Dict, Hashable, Optional
 
 import grpc
 
 from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+
+
+class CircuitBreaker:
+    """Per-peer circuit breaker with half-open probes (docs/FAULT_TOLERANCE.md).
+
+    CLOSED counts consecutive failures; at `failures` it OPENS and
+    `allow()` refuses every call for `reset_s`.  After the cooldown the
+    breaker goes HALF-OPEN and grants exactly ONE probe call; the probe's
+    outcome decides — success closes the breaker, failure re-opens it for
+    another full cooldown.  All transitions are thread-safe; senders that
+    fire-and-forget report outcomes from future done-callbacks.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failures: int = 5, reset_s: float = 10.0,
+                 metrics=None, name: str = ""):
+        self.failures = max(1, int(failures))
+        self.reset_s = float(reset_s)
+        self._metrics = metrics
+        self._name = name
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._count = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+        self._probe_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call proceed right now?  In HALF_OPEN only one probe is
+        granted at a time; callers that get True MUST report the outcome
+        via record_ok/record_failure or the breaker stays probe-locked
+        until the next cooldown."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            now = time.monotonic()
+            if self._state == self.OPEN:
+                if now - self._opened_at < self.reset_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._probe_inflight = False
+            # HALF_OPEN: one probe slot — but a probe whose outcome never
+            # arrived (a black-holed fire-and-forget send) must not lock
+            # the breaker forever, so the slot re-opens after reset_s
+            if self._probe_inflight and now - self._probe_at < self.reset_s:
+                return False
+            self._probe_inflight = True
+            self._probe_at = now
+            return True
+
+    def record_ok(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._count = 0
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._trip()
+                return
+            self._count += 1
+            if self._state == self.CLOSED and self._count >= self.failures:
+                self._trip()
+
+    def _trip(self) -> None:
+        self._state = self.OPEN
+        self._opened_at = time.monotonic()
+        self._count = 0
+        self._probe_inflight = False
+        if self._metrics is not None:
+            self._metrics.counter("rpc.breaker.open").increment()
+
+
+class RpcPolicy:
+    """One client-side RPC fault policy for the whole control plane
+    (docs/FAULT_TOLERANCE.md): per-call deadline, exponential backoff
+    with full jitter, a retry budget, and per-peer circuit breakers with
+    half-open probes.  Replaces the scattered hardcoded ``timeout=5.0``
+    and fixed-sleep retries across registration, peer introduction,
+    heartbeat, StopAsync, and gossip.
+
+    Defaults keep the reference's registration behavior as the baseline:
+    a 5 s call deadline (Slave.scala:48) and a 2 s first retry delay
+    (Slave.scala:56) — now growing exponentially with full jitter
+    (AWS-style: sleep ~ U(0, min(cap, base * mult^attempt))) up to a
+    ~30 s cap instead of retrying every 2 s forever.
+    """
+
+    def __init__(
+        self,
+        deadline_s: float = 5.0,            # Slave.scala:48
+        initial_backoff_s: float = 2.0,     # Slave.scala:56
+        max_backoff_s: float = 30.0,
+        multiplier: float = 2.0,
+        retries: int = 3,                   # budget for call_with_retry
+        breaker_failures: int = 5,
+        breaker_reset_s: float = 10.0,
+        seed: Optional[int] = None,
+        metrics=None,
+    ):
+        if deadline_s <= 0 or initial_backoff_s <= 0 or max_backoff_s <= 0:
+            raise ValueError("RpcPolicy deadlines/backoffs must be > 0")
+        if multiplier < 1.0:
+            raise ValueError("RpcPolicy multiplier must be >= 1")
+        self.deadline_s = float(deadline_s)
+        self.initial_backoff_s = float(initial_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
+        self.multiplier = float(multiplier)
+        self.retries = max(0, int(retries))
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self._metrics = metrics
+        self._rng = random.Random(seed)
+        self._breakers: Dict[Hashable, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    def backoff_cap_s(self, attempt: int) -> float:
+        """Deterministic exponential cap for retry `attempt` (0-based)."""
+        return min(self.max_backoff_s,
+                   self.initial_backoff_s * self.multiplier ** attempt)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Full-jitter sleep for retry `attempt`: U(0, cap(attempt))."""
+        return self._rng.uniform(0.0, self.backoff_cap_s(attempt))
+
+    def breaker(self, peer: Hashable) -> CircuitBreaker:
+        """The per-peer breaker (created on first use)."""
+        with self._lock:
+            br = self._breakers.get(peer)
+            if br is None:
+                br = CircuitBreaker(self.breaker_failures,
+                                    self.breaker_reset_s,
+                                    metrics=self._metrics, name=str(peer))
+                self._breakers[peer] = br
+            return br
+
+    def call_with_retry(self, call, request, peer: Hashable = None,
+                        retries: Optional[int] = None, log=None):
+        """Blocking unary call under the full policy: deadline per
+        attempt, breaker consult (peer given), jittered backoff between
+        attempts, at most `retries` re-attempts.  Raises the last
+        grpc.RpcError when the budget is spent or the breaker refuses."""
+        budget = self.retries if retries is None else max(0, int(retries))
+        br = self.breaker(peer) if peer is not None else None
+        last: Optional[Exception] = None
+        for attempt in range(budget + 1):
+            if br is not None and not br.allow():
+                raise last if last is not None else _breaker_open_error(peer)
+            try:
+                reply = call(request, timeout=self.deadline_s)
+                if br is not None:
+                    br.record_ok()
+                return reply
+            except grpc.RpcError as e:
+                if br is not None:
+                    br.record_failure()
+                last = e
+                if attempt < budget:
+                    delay = self.backoff_s(attempt)
+                    if log is not None:
+                        log.warning("rpc to %s failed (%s); retry %d/%d in %.1fs",
+                                    peer, e.code(), attempt + 1, budget, delay)
+                    time.sleep(delay)
+        raise last
+
+
+class BreakerOpenError(grpc.RpcError):
+    """Raised client-side when a peer's breaker refuses the call; carries
+    the .code()/.details() surface callers read off grpc.RpcError."""
+
+    def __init__(self, peer):
+        super().__init__()
+        self._peer = peer
+
+    def code(self) -> grpc.StatusCode:  # noqa: D102 - grpc surface
+        return grpc.StatusCode.UNAVAILABLE
+
+    def details(self) -> str:  # noqa: D102 - grpc surface
+        return f"circuit breaker open for {self._peer}"
+
+    def __str__(self):
+        return self.details()
+
+
+def _breaker_open_error(peer) -> grpc.RpcError:
+    return BreakerOpenError(peer)
 
 _MASTER_METHODS = {
     "RegisterSlave": (pb.Node, pb.Ack),
@@ -107,14 +303,27 @@ class GossipSender:
     call already executing server-side may still be delivered despite the
     cancel) — the same drop-oldest-under-overload policy as the in-process
     engine's bounded inbox (parallel/hogwild.py).
+
+    With a `breaker` (CircuitBreaker), sends to a partitioned peer are
+    SUPPRESSED while the breaker is open — one half-open probe per
+    cooldown instead of 64 in-flight cancels — counted under
+    `slave.async.grad.suppressed`; every real send's outcome feeds the
+    breaker from its done-callback (a cancel from the drop-oldest window
+    is NOT a peer failure and reports nothing).  `deadline_s` bounds each
+    send so a black-holed peer's futures FAIL (DEADLINE_EXCEEDED) instead
+    of hanging forever — without it nothing would ever reach the breaker
+    on a silent partition, because the only exit for a hung future is our
+    own drop-oldest cancel, which deliberately reports nothing.
     """
 
-    def __init__(self, call, metrics=None, max_inflight: int = 64):
-        import threading
-
+    def __init__(self, call, metrics=None, max_inflight: int = 64,
+                 breaker: Optional[CircuitBreaker] = None,
+                 deadline_s: Optional[float] = None):
         self._call = call  # e.g. stub.UpdateGrad
         self._metrics = metrics
         self.max_inflight = max(1, int(max_inflight))
+        self.breaker = breaker
+        self.deadline_s = deadline_s
         self._inflight: list = []
         # close() may run on a gRPC servicer thread (peer unregistered)
         # while the async loop still holds a snapshot of this sender: the
@@ -123,9 +332,23 @@ class GossipSender:
         self._lock = threading.Lock()
         self._closed = False
 
+    def _report_to_breaker(self, fut) -> None:
+        if fut.cancelled():
+            return  # our own drop-oldest window, not the peer's fault
+        try:
+            failed = fut.exception() is not None
+        except Exception:  # noqa: BLE001 - treat an unreadable future as failed
+            failed = True
+        (self.breaker.record_failure if failed else self.breaker.record_ok)()
+
     def send(self, msg) -> None:
         with self._lock:
             if self._closed:
+                return
+            if self.breaker is not None and not self.breaker.allow():
+                if self._metrics is not None:
+                    self._metrics.counter(
+                        "slave.async.grad.suppressed").increment()
                 return
             self._inflight = [f for f in self._inflight if not f.done()]
             while len(self._inflight) >= self.max_inflight:
@@ -142,9 +365,15 @@ class GossipSender:
                         and metrics.counter("slave.async.grad.dropped").increment()
                     )
             try:
-                self._inflight.append(self._call.future(msg))
+                if self.deadline_s is not None:
+                    fut = self._call.future(msg, timeout=self.deadline_s)
+                else:
+                    fut = self._call.future(msg)
             except ValueError:  # channel closed under us
-                pass
+                return
+            self._inflight.append(fut)
+            if self.breaker is not None:
+                fut.add_done_callback(self._report_to_breaker)
 
     @property
     def inflight(self) -> int:
@@ -171,10 +400,19 @@ def new_server(port: int, host: str = "0.0.0.0", max_workers: int = 16) -> grpc.
     return server
 
 
-def new_channel(host: str, port: int) -> grpc.Channel:
-    """Plaintext channel factory (core/package.scala:19-21)."""
-    return grpc.insecure_channel(
+def new_channel(host: str, port: int, origin=None) -> grpc.Channel:
+    """Plaintext channel factory (core/package.scala:19-21).
+
+    `origin` (the caller's own (host, port), optional) labels the edge
+    for the fault-injection layer: when a chaos plan is installed
+    (chaos/, DSGD_CHAOS) the channel is wrapped so every RPC through it
+    passes the plan's drop/delay/dup/partition decisions — a no-op
+    returning the raw channel otherwise."""
+    channel = grpc.insecure_channel(
         f"{host}:{port}",
         options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
                  ("grpc.max_send_message_length", 64 * 1024 * 1024)],
     )
+    from distributed_sgd_tpu import chaos
+
+    return chaos.wrap_channel(channel, target=(host, int(port)), origin=origin)

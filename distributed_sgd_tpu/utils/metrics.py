@@ -246,6 +246,22 @@ def record_broadcast(metrics: "Metrics", form: str, n_bytes: int) -> None:
     metrics.counter(f"master.sync.bcast.{form}").increment()
 
 
+# -- quorum barrier / fault tolerance (docs/FAULT_TOLERANCE.md) ---------------
+#
+# Master-side instruments for the quorum sync barrier (DSGD_QUORUM), the
+# breaker-aware transports, and the chaos layer.  `stalled` counts barriers
+# that overran the soft deadline WITHOUT quorum relief (quorum off, or
+# below-quorum fallback) — the headline benches/bench_chaos.py gates on;
+# quorum-satisfied overruns count under `degraded` instead.
+QUORUM_DEGRADED = "master.sync.quorum.degraded"    # rounds closed at < full strength
+QUORUM_HEDGES = "master.sync.quorum.hedges"        # hedge Gradient requests issued
+QUORUM_HEDGE_WINS = "master.sync.quorum.hedge_wins"  # slices covered by a hedge
+QUORUM_LATE = "master.sync.quorum.late"            # late replies discarded idempotently
+SYNC_STALLED = "master.sync.barrier.stalled"       # soft-deadline overruns, no relief
+BREAKER_OPEN = "rpc.breaker.open"                  # breaker trips (service.py)
+GOSSIP_SUPPRESSED = "slave.async.grad.suppressed"  # sends refused by an open breaker
+
+
 _GLOBAL = Metrics()
 
 

@@ -1,0 +1,271 @@
+"""Deterministic fault-injection layer (chaos/; docs/FAULT_TOLERANCE.md).
+
+Plan grammar, per-edge deterministic streams, each fault mode observed
+through a REAL loopback gRPC stub (error/delay/drop/partition), and the
+end-to-end soak: a DevCluster fit under an injected-fault plan with
+quorum barriers completes, evicts nobody, and converges.
+"""
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu import chaos
+from distributed_sgd_tpu.chaos import (
+    ChaosState,
+    FaultPlan,
+    Partition,
+    _ChaosCallable,
+    parse_plan,
+)
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import (
+    WorkerStub,
+    add_worker_servicer,
+    new_channel,
+    new_server,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    """Every test starts and ends with no plan installed — a leaked plan
+    would silently wrap every other test's channels."""
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+
+
+# -- plan grammar -------------------------------------------------------------
+
+
+def test_parse_plan_full_spec():
+    p = parse_plan("seed=7;drop=0.05;delay=20ms~200ms;dup=0.01;error=0.002;"
+                   "grace=1.5s;partition=w2:10s@30s,master:500ms@5s")
+    assert p.seed == 7 and p.drop == 0.05 and p.dup == 0.01
+    assert p.error == 0.002 and p.grace_s == 1.5
+    assert p.delay == (0.02, 0.2)
+    assert p.partitions == (Partition("w2", 10.0, 30.0),
+                            Partition("master", 0.5, 5.0))
+
+
+def test_parse_plan_rejects_typos():
+    for bad in ("drop", "drop=2.0", "frobnicate=1", "delay=xyz",
+                "partition=w2", "partition=w2:10s", "delay=200ms~20ms"):
+        with pytest.raises(ValueError):
+            parse_plan(bad)
+    assert parse_plan("delay=50ms").delay == (0.05, 0.05)
+    assert parse_plan("").drop == 0.0  # empty plan parses to all-clear
+
+
+# -- deterministic per-edge streams -------------------------------------------
+
+
+class _Settled:
+    """Minimal settled future for the fake inner callable."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+    def done(self):
+        return True
+
+    def cancelled(self):
+        return False
+
+    def cancel(self):
+        return False
+
+    def exception(self, timeout=None):
+        return None
+
+    def add_done_callback(self, fn):
+        fn(self)
+
+
+class _Inner:
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, request, timeout=None):
+        self.calls += 1
+        return "ok"
+
+    def future(self, request, timeout=None):
+        self.calls += 1
+        return _Settled("ok")
+
+
+def _outcomes(seed: int, n: int = 60):
+    state = ChaosState(FaultPlan(seed=seed, drop=0.3, error=0.1))
+    call = _ChaosCallable(_Inner(), "Ping", ("t", 1), ("o", 2), state)
+    out = []
+    for _ in range(n):
+        try:
+            call(None, timeout=0.001)
+            out.append("ok")
+        except grpc.RpcError as e:
+            out.append(e.code().name)
+    return out
+
+
+def test_fault_stream_replays_for_same_seed_and_differs_across_seeds():
+    a, b = _outcomes(7), _outcomes(7)
+    assert a == b, "same plan + same edge must inject the same faults"
+    assert "DEADLINE_EXCEEDED" in a and "UNAVAILABLE" in a and "ok" in a
+    assert _outcomes(8) != a
+
+
+def test_edges_draw_independent_streams():
+    state = ChaosState(FaultPlan(seed=7, drop=0.5))
+    r1 = [state.rng(("a", 1), ("b", 2), "Gradient").random() for _ in range(20)]
+    r2 = [state.rng(("a", 1), ("c", 3), "Gradient").random() for _ in range(20)]
+    assert r1 != r2
+
+
+# -- each fault mode through a real loopback stub -----------------------------
+
+
+class _PingServicer:
+    def Ping(self, request, context):  # noqa: N802
+        return pb.Ack()
+
+    def __getattr__(self, name):
+        def unimplemented(request, context):
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, name)
+
+        return unimplemented
+
+
+@pytest.fixture()
+def ping_server():
+    server = new_server(0, host="127.0.0.1")
+    add_worker_servicer(server, _PingServicer())
+    server.start()
+    yield server.bound_port
+    server.stop(grace=0)
+
+
+def test_error_injection_on_real_stub(ping_server):
+    chaos.install("seed=1;error=1.0")
+    stub = WorkerStub(new_channel("127.0.0.1", ping_server))
+    with pytest.raises(grpc.RpcError) as err:
+        stub.Ping(pb.Empty(), timeout=5.0)
+    assert err.value.code() == grpc.StatusCode.UNAVAILABLE
+
+
+def test_drop_black_holes_until_deadline(ping_server):
+    chaos.install("seed=1;drop=1.0")
+    stub = WorkerStub(new_channel("127.0.0.1", ping_server))
+    t0 = time.monotonic()
+    fut = stub.Ping.future(pb.Empty(), timeout=0.4)
+    with pytest.raises(grpc.RpcError) as err:
+        fut.result()
+    assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert time.monotonic() - t0 >= 0.35
+    # a deadline-less dropped future stays pending (fire-and-forget wire)
+    # until cancelled — the bounded gossip window's contract
+    fut2 = stub.Ping.future(pb.Empty())
+    assert not fut2.done()
+    assert fut2.cancel()
+    assert fut2.cancelled()
+
+
+def test_delay_injected_without_blocking_the_fanout(ping_server):
+    chaos.install("seed=1;delay=300ms")
+    stub = WorkerStub(new_channel("127.0.0.1", ping_server))
+    t0 = time.monotonic()
+    fut = stub.Ping.future(pb.Empty(), timeout=5.0)
+    dispatch_s = time.monotonic() - t0
+    assert dispatch_s < 0.2, "delay must ride the future, not the caller"
+    fut.result()
+    assert time.monotonic() - t0 >= 0.28
+    # blocking calls pay the delay inline and keep their deadline semantics
+    t0 = time.monotonic()
+    stub.Ping(pb.Empty(), timeout=5.0)
+    assert time.monotonic() - t0 >= 0.28
+    with pytest.raises(grpc.RpcError) as err:
+        stub.Ping(pb.Empty(), timeout=0.05)  # deadline inside the delay
+    assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+
+
+def test_partition_window_opens_and_heals(ping_server):
+    chaos.install("seed=1;partition=victim:400ms@0s")
+    chaos.name_endpoint("127.0.0.1", ping_server, "victim")
+    chaos.arm()
+    stub = WorkerStub(new_channel("127.0.0.1", ping_server))
+    with pytest.raises(grpc.RpcError) as err:
+        stub.Ping(pb.Empty(), timeout=0.2)
+    assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    time.sleep(0.5)  # window over: the partition heals
+    assert stub.Ping(pb.Empty(), timeout=5.0) is not None
+
+
+def test_grace_and_unarmed_states_inject_nothing(ping_server):
+    st = chaos.install("seed=1;drop=1.0", armed=False)
+    stub = WorkerStub(new_channel("127.0.0.1", ping_server))
+    assert stub.Ping(pb.Empty(), timeout=5.0) is not None  # un-armed: clear
+    assert not st.armed
+    chaos.install("seed=1;drop=1.0;grace=30s")
+    stub2 = WorkerStub(new_channel("127.0.0.1", ping_server))
+    assert stub2.Ping(pb.Empty(), timeout=5.0) is not None  # inside grace
+
+
+def test_no_plan_returns_raw_channel(ping_server):
+    ch = new_channel("127.0.0.1", ping_server)
+    assert isinstance(ch, grpc.Channel), "no plan must mean no wrapper"
+
+
+# -- end-to-end: chaos + quorum soak ------------------------------------------
+
+
+@pytest.mark.slow
+def test_devcluster_fit_survives_chaos_with_quorum():
+    """Mild weather (drops + delays + dups) on a 3-worker cluster with
+    quorum=2: the fit completes every epoch, nobody is evicted, and the
+    loss goes down.  The bench (bench.py --chaos --smoke) is the gated
+    big sibling of this soak."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+    from distributed_sgd_tpu.data.synthetic import rcv1_like
+    from distributed_sgd_tpu.models.linear import make_model
+    from distributed_sgd_tpu.utils import metrics as mm
+
+    train, test = train_test_split(
+        rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=31,
+                  idf_values=True))
+    ds = dim_sparsity(train)
+    model = make_model("hinge", 1e-5, train.n_features, dim_sparsity=ds)
+    g = mm.global_metrics()
+    drops0 = g.counter("chaos.injected.drop").value
+    with DevCluster(model, train, test, n_workers=3,
+                    chaos="seed=7;drop=0.08;delay=2ms~10ms;dup=0.02") as c:
+        res = c.master.fit_sync(
+            max_epochs=2, batch_size=16, learning_rate=0.5,
+            grad_timeout_s=2.0, quorum=2, straggler_soft_s=0.4)
+        assert len(c.master._workers) == 3, "chaos must not evict live workers"
+    assert chaos.state() is None, "DevCluster must uninstall its plan"
+    assert res.epochs_run == 2
+    assert res.losses[-1] < res.losses[0]
+    assert g.counter("chaos.injected.drop").value > drops0, (
+        "the plan injected nothing — the soak proved nothing")
+
+
+@pytest.mark.slow
+def test_chaos_smoke_bench_end_to_end():
+    """`bench.py --chaos --smoke` is the CI chaos gate: completion, zero
+    evictions, loss parity, and the >= 3x stalled-round improvement under
+    the canonical fault plan, reported through benches/regress.py."""
+    from benches.bench_chaos import run_bench
+
+    r = run_bench(smoke=True)  # raises on any gate failure
+    assert r["zero_evictions"] == 1
+    assert r["completed"] == 1
+    assert r["loss_parity_ok"] == 1
+    assert r["stall_improvement_x"] >= 3.0
+    assert r["knobs_off_drift"] == 0.0

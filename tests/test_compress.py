@@ -8,6 +8,8 @@ convergence: sync fan-in and async gossip at k/dim = 1% with error
 feedback must land within 2% relative final train loss of uncompressed.
 """
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -269,6 +271,7 @@ def test_sync_reply_retry_rolls_back_residual_drain():
     w._compressor = TopKCompressor(k=4, error_feedback=True,
                                    metrics=metrics_mod.Metrics())
     w._sync_ef_guard = (None, None)
+    w._sync_guard_lock = threading.Lock()
     rng = np.random.default_rng(17)
     g0, g1, g2 = (_vec(rng, 200) for _ in range(3))
 
@@ -304,6 +307,7 @@ def test_new_fit_token_drops_sync_residual():
     w._compressor = TopKCompressor(k=4, error_feedback=True,
                                    metrics=metrics_mod.Metrics())
     w._sync_ef_guard = (None, None)
+    w._sync_guard_lock = threading.Lock()
     w._sync_fit_token = 0
     rng = np.random.default_rng(23)
     g1, g2, g3 = (_vec(rng, 200) for _ in range(3))

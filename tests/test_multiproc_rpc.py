@@ -123,6 +123,108 @@ def test_three_process_fit(mode, tmp_path):
 
 
 @pytest.mark.slow
+def test_sync_quorum_survives_sigstopped_worker_process(tmp_path):
+    """The straggler-that-isn't-dead proof (docs/FAULT_TOLERANCE.md): a
+    REAL worker process is SIGSTOPped (not SIGKILLed) mid-sync-fit — the
+    OS keeps its sockets open, so nothing fails fast; it is just
+    infinitely slow.  With DSGD_QUORUM=1 (N-1 of 2) the epoch keeps
+    closing rounds on the live worker (the straggler's slice hedged to
+    it), the stopped worker is NEVER declared dead and never triggers a
+    re-split, and after SIGCONT it rejoins the running fit through the
+    versioned-broadcast fallback (its stale replica gets a full
+    broadcast, no membership change).  Without quorum this exact
+    scenario wedges every window until the gradient deadline."""
+    import threading
+
+    extra = {
+        "DSGD_MAX_EPOCHS": "5",
+        "DSGD_QUORUM": "1",
+        "DSGD_STRAGGLER_SOFT_S": "0.5",
+        "DSGD_DELTA_BROADCAST": "1",
+        "DSGD_PATIENCE": "50",  # no early stop: run all epochs
+        "DSGD_CONV_DELTA": "0",
+    }
+    master_port, *worker_ports = _free_ports(3)
+    cmd = [sys.executable, "-m", "distributed_sgd_tpu.main"]
+    procs = []
+    worker_logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    lines: list = []
+    try:
+        with contextlib.ExitStack() as stack:
+            master = subprocess.Popen(
+                cmd, env=_env(master_port, master_port, extra),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            procs.append(master)
+            for port, logf in zip(worker_ports, worker_logs):
+                w = subprocess.Popen(
+                    cmd, env=_env(port, master_port, extra),
+                    stdout=stack.enter_context(open(logf, "w")),
+                    stderr=subprocess.STDOUT,
+                )
+                procs.append(w)
+
+            def pump():
+                for ln in master.stdout:
+                    lines.append(ln)
+
+            reader = threading.Thread(target=pump, daemon=True)
+            reader.start()
+
+            def saw(needle):
+                return any(needle in ln for ln in lines)
+
+            def diag():
+                tails = "\n".join(
+                    f"== {f.name}:\n{f.read_text()[-1200:]}" for f in worker_logs
+                    if f.exists())
+                return f"{''.join(lines)[-3000:]}\n{tails}"
+
+            deadline = time.time() + 300
+            while time.time() < deadline and not saw("epoch 0:"):
+                if master.poll() is not None:
+                    raise AssertionError(f"master exited early:\n{diag()}")
+                time.sleep(0.2)
+            assert saw("epoch 0:"), f"fit never finished an epoch:\n{diag()}"
+
+            procs[1].send_signal(signal.SIGSTOP)  # freeze, don't kill
+            time.sleep(4.0)  # several windows must close without it
+            procs[1].send_signal(signal.SIGCONT)  # ...and then it wakes up
+
+            try:
+                master.wait(timeout=300)
+            except subprocess.TimeoutExpired:
+                master.kill()
+                raise AssertionError(
+                    f"master wedged on the stopped worker:\n{diag()}")
+            reader.join(timeout=10)
+            out = "".join(lines)
+            assert master.returncode == 0, diag()
+            assert "fit done: 5 epochs" in out, diag()
+            # the straggler was hedged around, not evicted: no death, no
+            # membership change, no re-split of the data
+            assert "hedging slice" in out, diag()
+            assert "declared dead" not in out, diag()
+            assert "re-split" not in out, diag()
+            assert "unregistered" not in out, diag()
+    finally:
+        deadline = time.time() + 10
+        for p in procs[1:]:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGCONT)  # un-freeze before TERM
+                except ProcessLookupError:
+                    pass
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+@pytest.mark.slow
 def test_async_fit_survives_sigkilled_worker_process(tmp_path):
     """The gold-standard async fault proof: a REAL worker process is
     SIGKILLed mid-fit (no unregister, no TCP FIN courtesy — the OS just

@@ -1,0 +1,548 @@
+"""Quorum barriers + straggler hedging + the unified RpcPolicy
+(docs/FAULT_TOLERANCE.md).
+
+Correctness story under test: with DSGD_QUORUM unset nothing changes (no
+new wire fields, no new counters, bit-identical weights even when the
+soft-deadline observer runs); with quorum set, a slow-but-alive worker
+degrades rounds instead of stalling them — its slice is hedged to a fast
+worker, its late replies are discarded idempotently, it is never evicted
+— and error-feedback residuals of non-contributing workers telescope
+correctly across skipped rounds (no drain, no double-apply) for the topk
+and qint8 codecs.
+"""
+
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.core.master import _LatencyEwma, _await_quorum
+from distributed_sgd_tpu.core.worker import WorkerNode, _WorkerServicer
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import CircuitBreaker, GossipSender, RpcPolicy
+from distributed_sgd_tpu.utils import metrics as mm
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(
+        rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=31,
+                  idf_values=True))
+
+
+@pytest.fixture(scope="module")
+def model_fn(data):
+    train, _ = data
+    ds = dim_sparsity(train)
+    return lambda: make_model("hinge", 1e-5, train.n_features,
+                              dim_sparsity=ds)
+
+
+def _counters():
+    g = mm.global_metrics()
+    names = (mm.QUORUM_DEGRADED, mm.QUORUM_HEDGES, mm.QUORUM_HEDGE_WINS,
+             mm.QUORUM_LATE, mm.SYNC_STALLED)
+    return {n: g.counter(n).value for n in names}
+
+
+def _fit(cluster, **kw):
+    kw.setdefault("max_epochs", 2)
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("learning_rate", 0.5)
+    return cluster.master.fit_sync(**kw)
+
+
+# -- knobs-off invariance -----------------------------------------------------
+
+
+def test_knobs_off_wire_and_weights_identical(data, model_fn):
+    """DSGD_QUORUM unset: no request carries the quorum fields, no quorum
+    counter moves, and the soft-deadline observer (straggler_soft_s
+    without quorum) is pure observation — bit-identical final weights."""
+    train, test = data
+    seen = []
+    b0 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        for w in c.workers:
+            orig = w.resolve_request_weights
+
+            def spy(request, _orig=orig):
+                seen.append((request.ef_rollback_version, request.hedge))
+                return _orig(request)
+
+            w.resolve_request_weights = spy
+        plain = _fit(c)
+    b1 = _counters()
+    assert seen, "no Gradient request observed"
+    for rb, hedge in seen:
+        assert rb == 0 and not hedge
+    assert all(b1[k] == b0[k] for k in b0 if k != mm.SYNC_STALLED)
+    # observation-only run: counts stalls but must not perturb the fit
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        observed = _fit(c, straggler_soft_s=300.0)
+    assert np.array_equal(plain.state.weights, observed.state.weights)
+
+
+# -- degraded rounds with a live straggler ------------------------------------
+
+
+def _slow_down(worker, seconds):
+    orig = worker.compute_gradient
+
+    def slow(w, ids, _orig=orig):
+        time.sleep(seconds)
+        return _orig(w, ids)
+
+    worker.compute_gradient = slow
+    return orig
+
+
+def test_straggler_degrades_rounds_without_eviction(data, model_fn):
+    """One worker 10x past the soft deadline: quorum=N-1 finishes every
+    epoch on time, hedges the straggler's slice, counts degraded rounds,
+    and the straggler is still a member at the end (slow != dead)."""
+    train, test = data
+    b0 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=3) as c:
+        _slow_down(c.workers[0], 1.0)
+        res = _fit(c, quorum=2, straggler_soft_s=0.1, grad_timeout_s=15.0)
+        assert len(c.master._workers) == 3, "the straggler must NOT be evicted"
+    b1 = _counters()
+    sent = {k: b1[k] - b0[k] for k in b0}
+    assert res.epochs_run == 2
+    assert res.losses[-1] < res.losses[0]
+    assert sent[mm.QUORUM_DEGRADED] > 0, "no round was ever degraded"
+    assert sent[mm.QUORUM_HEDGES] > 0, "the straggler's slice was never hedged"
+    assert sent[mm.QUORUM_HEDGE_WINS] > 0
+
+
+def test_quorum_composes_with_delta_broadcast_and_compression(data, model_fn):
+    """The PR 2/3 machinery must survive quorum degradation: versioned
+    broadcasts fall back to full for the straggler (it misses versions),
+    topk EF replies stay correct via the rollback mask, and the fit
+    converges."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=3,
+                    compress="topk", compress_k=0.1) as c:
+        _slow_down(c.workers[0], 1.0)
+        res = _fit(c, max_epochs=3, quorum=2, straggler_soft_s=0.1,
+                   grad_timeout_s=15.0, delta_broadcast=True)
+        assert len(c.master._workers) == 3
+    assert res.epochs_run == 3
+    assert res.losses[-1] < res.losses[0]
+
+
+def test_quorum_stamps_versions_on_the_plain_wire(data, model_fn):
+    """Quorum without delta_broadcast: requests still carry the full
+    dense tensor but are version-stamped, and the master marks the
+    straggler's discarded windows with a REAL (nonzero) rollback version
+    — on the unversioned wire the marker would serialize to nothing and
+    quorum + compression would silently drain the straggler's residual.
+    (The worker-side exact-match application is proven sequentially by
+    the test_ef_rollback_* units; a continuously-slow straggler
+    processes windows concurrently, where the guard is best-effort.)"""
+    train, test = data
+    seen = []
+    with DevCluster(model_fn(), train, test, n_workers=3,
+                    compress="topk", compress_k=0.1) as c:
+        for w in c.workers:
+            orig = w.resolve_request_weights
+
+            def spy(request, _orig=orig):
+                seen.append((request.HasField("weights"),
+                             request.step_version,
+                             request.ef_rollback_version))
+                return _orig(request)
+
+            w.resolve_request_weights = spy
+        _slow_down(c.workers[0], 1.0)
+        res = _fit(c, quorum=2, straggler_soft_s=0.1, grad_timeout_s=15.0)
+    assert res.losses[-1] < res.losses[0]
+    assert seen
+    for has_w, ver, _rb in seen:
+        assert has_w and ver > 0, "quorum must version-stamp the full wire"
+    assert any(rb > 0 for _, _, rb in seen), (
+        "no discarded window was ever marked for EF rollback on the "
+        "plain wire")
+
+
+def test_below_quorum_falls_back_to_full_barrier(data, model_fn):
+    """Both of 2 workers slower than the soft deadline with quorum=2:
+    no degradation is possible, every window runs as a full barrier
+    (stalled counted), and the result is exact — identical weights to the
+    same fit without quorum."""
+    train, test = data
+    b0 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        for w in c.workers:
+            _slow_down(w, 0.12)
+        res = _fit(c, max_epochs=1, quorum=2, straggler_soft_s=0.02,
+                   grad_timeout_s=15.0)
+    b1 = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        ref = _fit(c, max_epochs=1)
+    assert b1[mm.SYNC_STALLED] - b0[mm.SYNC_STALLED] > 0
+    assert np.array_equal(res.state.weights, ref.state.weights)
+
+
+# -- EF correctness under quorum (acceptance criterion) -----------------------
+
+
+@pytest.fixture()
+def lone_worker_factory(data, model_fn):
+    made = []
+
+    def make(**kw):
+        train, _ = data
+        w = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, train, model_fn(), **kw)
+        made.append(w)
+        return w
+
+    yield make
+    for w in made:
+        w._master_channel.close()
+        w.server.stop(grace=0)
+
+
+def _grad_req(w_vec, ids, version, tok=5, rollback=0):
+    r = pb.GradientRequest(
+        weights=codec.encode_tensor(w_vec), samples=np.asarray(ids, np.int32),
+        fit_token=tok, step_version=version)
+    if rollback:
+        r.ef_rollback_version = rollback
+    return r
+
+
+def test_ef_rollback_telescopes_topk(lone_worker_factory):
+    """A worker whose window-1 reply the master discarded must, after the
+    rollback mark, encode window 2 EXACTLY as a worker that never saw
+    window 1 — no drain of the residual, no double-apply of shipped mass."""
+    wk = lone_worker_factory(compress="topk", compress_k=0.05)
+    twin = lone_worker_factory(compress="topk", compress_k=0.05)
+    dim = wk.model.n_features
+    sv, tw = _WorkerServicer(wk), _WorkerServicer(twin)
+    w1 = np.zeros(dim, dtype=np.float32)
+    w2 = np.linspace(-0.1, 0.1, dim).astype(np.float32)
+    ids1, ids2 = np.arange(8), np.arange(8, 16)
+
+    r1 = sv.Gradient(_grad_req(w1, ids1, 1), None)  # drained, then discarded
+    r2 = sv.Gradient(_grad_req(w2, ids2, 2, rollback=1), None)
+    r2_twin = tw.Gradient(_grad_req(w2, ids2, 1), None)
+    np.testing.assert_array_equal(
+        codec.decode_grad(r2), codec.decode_grad(r2_twin))
+    # counterfactual: WITHOUT the rollback the discarded window's unsent
+    # mass leaks into window 2 (this is what the mask prevents)
+    leaky = lone_worker_factory(compress="topk", compress_k=0.05)
+    lv = _WorkerServicer(leaky)
+    lv.Gradient(_grad_req(w1, ids1, 1), None)
+    r2_leaky = lv.Gradient(_grad_req(w2, ids2, 2), None)
+    assert not np.array_equal(
+        codec.decode_grad(r2_leaky), codec.decode_grad(r2_twin)), (
+        "test vacuous: window 1 left no residual to roll back")
+    assert not r1.stale_version
+
+
+def test_ef_rollback_telescopes_qint8(lone_worker_factory):
+    """qint8: after the rollback, residual + decoded reply == the true
+    window-2 gradient (the discarded window contributes nothing)."""
+    wk = lone_worker_factory(compress="qint8")
+    dim = wk.model.n_features
+    sv = _WorkerServicer(wk)
+    w1 = np.zeros(dim, dtype=np.float32)
+    w2 = np.linspace(-0.1, 0.1, dim).astype(np.float32)
+    ids1, ids2 = np.arange(8), np.arange(8, 16)
+
+    sv.Gradient(_grad_req(w1, ids1, 1), None)  # drained, then discarded
+    r2 = sv.Gradient(_grad_req(w2, ids2, 2, rollback=1), None)
+    g2 = wk.compute_gradient(w2, np.asarray(ids2, np.int64))
+    residual = wk._compressor.residual_snapshot("sync:master")
+    # telescoping: shipped + residual reconstructs g2 alone — any window-1
+    # leakage would break this by the discarded reply's mass
+    np.testing.assert_allclose(
+        codec.decode_grad(r2) + residual, g2, rtol=0, atol=1e-4)
+
+
+def test_ef_rollback_is_idempotent_and_exact_match_only(lone_worker_factory):
+    wk = lone_worker_factory(compress="topk", compress_k=0.05)
+    sv = _WorkerServicer(wk)
+    dim = wk.model.n_features
+    w1 = np.zeros(dim, dtype=np.float32)
+    sv.Gradient(_grad_req(w1, np.arange(8), 1), None)
+    snap_after = wk._compressor.residual_snapshot("sync:master")
+    # mismatched version: the worker never encoded v7 — nothing happens
+    wk.rollback_sync_ef(7)
+    np.testing.assert_array_equal(
+        wk._compressor.residual_snapshot("sync:master"), snap_after)
+    # exact match rolls back...
+    wk.rollback_sync_ef(1)
+    assert wk._compressor.residual_snapshot("sync:master") is None
+    # ...and a repeat is a no-op (the guard was consumed)
+    wk.rollback_sync_ef(1)
+    assert wk._compressor.residual_snapshot("sync:master") is None
+
+
+def test_hedge_reply_is_uncompressed_and_leaves_residual_alone(
+        lone_worker_factory):
+    """A hedge request must not touch the donor's own sync EF residual —
+    otherwise the master's average double-counts the donor's residual mass
+    in the same round — and replies uncompressed (dense/sparse arm)."""
+    wk = lone_worker_factory(compress="topk", compress_k=0.05)
+    sv = _WorkerServicer(wk)
+    dim = wk.model.n_features
+    w1 = np.zeros(dim, dtype=np.float32)
+    sv.Gradient(_grad_req(w1, np.arange(8), 1), None)  # own reply: drains
+    before = wk._compressor.residual_snapshot("sync:master")
+    hreq = _grad_req(w1, np.arange(16, 24), 1)
+    hreq.hedge = True
+    hr = sv.Gradient(hreq, None)
+    assert hr.WhichOneof("grad") in ("dense", "sparse")
+    np.testing.assert_array_equal(
+        wk._compressor.residual_snapshot("sync:master"), before)
+    # exactness: the hedge reply IS the slice's true gradient
+    g = wk.compute_gradient(w1, np.arange(16, 24))
+    np.testing.assert_allclose(codec.decode_grad(hr), g, rtol=0, atol=1e-6)
+
+
+# -- barrier / EWMA units -----------------------------------------------------
+
+
+class _Fut:
+    def __init__(self, reply=None, exc=None, delay_done=None):
+        self._reply, self._exc = reply, exc
+        self._t_done = time.monotonic() + (delay_done or 0.0)
+
+    def done(self):
+        return time.monotonic() >= self._t_done
+
+    def result(self):
+        if self._exc is not None:
+            raise self._exc
+        return self._reply
+
+    def add_done_callback(self, fn):
+        pass
+
+    def cancelled(self):
+        return False
+
+
+def test_await_quorum_returns_at_soft_deadline_with_quorum():
+    reply = codec.encode_grad(np.ones(8, dtype=np.float32))
+    futs = [("a", _Fut(reply)), ("b", _Fut(reply)),
+            ("c", _Fut(reply, delay_done=30.0))]
+    t0 = time.monotonic()
+    ok, failed, pending = _await_quorum(futs, 2, t0 + 0.2)
+    assert [k for k, _ in ok] == ["a", "b"]
+    assert not failed and [k for k, _ in pending] == ["c"]
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_await_quorum_waits_past_soft_deadline_below_quorum():
+    reply = codec.encode_grad(np.ones(8, dtype=np.float32))
+    futs = [("a", _Fut(reply)), ("b", _Fut(reply, delay_done=0.6))]
+    t0 = time.monotonic()
+    ok, failed, pending = _await_quorum(futs, 2, t0 + 0.05)
+    assert len(ok) == 2 and not pending
+    assert time.monotonic() - t0 >= 0.5
+
+
+def test_latency_ewma_soft_deadline_tracks_quorum_fastest():
+    lat = _LatencyEwma()
+    assert lat.soft_deadline_s(["a", "b"], 2) is None  # cold: full barrier
+    for _ in range(20):
+        lat.record("a", 0.10)
+        lat.record("b", 0.12)
+        lat.record("c", 9.0)  # the straggler must not stretch the deadline
+    soft = lat.soft_deadline_s(["a", "b", "c"], 2)
+    assert 0.1 <= soft < 1.0
+    assert lat.soft_deadline_s(["a", "b", "c"], 3) > 9.0  # quorum=N waits for all
+
+
+# -- RpcPolicy / CircuitBreaker (unified retry policy) ------------------------
+
+
+def test_rpc_policy_backoff_grows_exponentially_with_full_jitter():
+    pol = RpcPolicy(seed=3)
+    assert [pol.backoff_cap_s(a) for a in range(6)] == [2, 4, 8, 16, 30, 30]
+    for attempt in range(8):
+        for _ in range(50):
+            assert 0.0 <= pol.backoff_s(attempt) <= pol.backoff_cap_s(attempt)
+    with pytest.raises(ValueError):
+        RpcPolicy(deadline_s=0)
+
+
+def test_circuit_breaker_state_machine():
+    br = CircuitBreaker(failures=2, reset_s=60.0)
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    br._opened_at -= 61.0  # cooldown elapsed
+    assert br.allow()  # the half-open probe slot
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow()  # only ONE probe at a time
+    br.record_failure()  # probe failed: re-open for a fresh cooldown
+    assert br.state == CircuitBreaker.OPEN and not br.allow()
+    br._opened_at -= 61.0
+    assert br.allow()
+    br.record_ok()  # probe succeeded: closed, counters reset
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow() and br.allow()
+
+
+def test_gossip_sender_suppressed_by_open_breaker():
+    class _Call:
+        def __init__(self):
+            self.sent = 0
+
+        def future(self, msg):
+            self.sent += 1
+            return _Fut(pb.Ack())
+
+    m = mm.Metrics()
+    call = _Call()
+    br = CircuitBreaker(failures=1, reset_s=60.0)
+    sender = GossipSender(call, m, max_inflight=4, breaker=br)
+    msg = codec.encode_grad(np.ones(4, dtype=np.float32))
+    sender.send(msg)
+    assert call.sent == 1
+    br.record_failure()  # trips at 1
+    for _ in range(10):
+        sender.send(msg)
+    assert call.sent == 1, "open breaker must suppress sends"
+    assert m.counter(mm.GOSSIP_SUPPRESSED).value == 10
+    br._opened_at -= 61.0
+    sender.send(msg)  # the half-open probe goes through
+    assert call.sent == 2
+
+
+def test_gossip_deadline_failures_open_the_breaker():
+    """A black-holed peer's gossip futures must FAIL (the send deadline)
+    and feed the breaker — without a deadline the only exit is our own
+    drop-oldest cancel, which deliberately reports nothing, and the
+    breaker would never open on a silent partition."""
+    from distributed_sgd_tpu.chaos import ChaosRpcError
+
+    class _FailedFut(_Fut):
+        def __init__(self):
+            super().__init__(exc=ChaosRpcError(
+                grpc.StatusCode.DEADLINE_EXCEEDED))
+
+        def exception(self, timeout=None):
+            return self._exc
+
+        def add_done_callback(self, fn):
+            fn(self)  # already settled: deliver immediately
+
+    class _DeadCall:
+        def __init__(self):
+            self.timeouts = []
+
+        def future(self, msg, timeout=None):
+            self.timeouts.append(timeout)
+            return _FailedFut()
+
+    m = mm.Metrics()
+    call = _DeadCall()
+    br = CircuitBreaker(failures=3, reset_s=60.0)
+    sender = GossipSender(call, m, max_inflight=4, breaker=br, deadline_s=5.0)
+    msg = codec.encode_grad(np.ones(4, dtype=np.float32))
+    for _ in range(3):
+        sender.send(msg)
+    assert call.timeouts == [5.0] * 3, "gossip sends must carry the deadline"
+    assert br.state == CircuitBreaker.OPEN, (
+        "deadline failures must trip the breaker")
+    sender.send(msg)
+    assert len(call.timeouts) == 3, "open breaker must suppress the send"
+    assert m.counter(mm.GOSSIP_SUPPRESSED).value == 1
+
+
+def test_rpc_policy_call_with_retry_and_breaker():
+    from distributed_sgd_tpu.chaos import ChaosRpcError
+
+    attempts = []
+
+    def flaky(request, timeout=None):
+        attempts.append(timeout)
+        if len(attempts) < 3:
+            raise ChaosRpcError(grpc.StatusCode.UNAVAILABLE)
+        return "ok"
+
+    pol = RpcPolicy(deadline_s=1.5, initial_backoff_s=0.01,
+                    max_backoff_s=0.02, retries=3, seed=0)
+    assert pol.call_with_retry(flaky, None, peer="p") == "ok"
+    assert len(attempts) == 3 and all(t == 1.5 for t in attempts)
+    assert pol.breaker("p").state == CircuitBreaker.CLOSED
+
+    def always_down(request, timeout=None):
+        raise ChaosRpcError(grpc.StatusCode.UNAVAILABLE)
+
+    pol2 = RpcPolicy(deadline_s=0.5, initial_backoff_s=0.01,
+                     max_backoff_s=0.02, retries=2, breaker_failures=2)
+    with pytest.raises(grpc.RpcError):
+        pol2.call_with_retry(always_down, None, peer="q")
+    assert pol2.breaker("q").state == CircuitBreaker.OPEN
+
+
+# -- config knobs -------------------------------------------------------------
+
+
+def test_config_chaos_knobs_env_and_validation(monkeypatch):
+    from distributed_sgd_tpu.config import Config
+
+    for key, value in {
+        "DSGD_QUORUM": "2", "DSGD_STRAGGLER_SOFT_S": "0.5",
+        "DSGD_HEARTBEAT_MAX_MISSES": "7",
+        "DSGD_CHAOS": "seed=3;drop=0.1;delay=5ms~10ms",
+    }.items():
+        monkeypatch.setenv(key, value)
+    cfg = Config.from_env()
+    assert (cfg.quorum, cfg.straggler_soft_s, cfg.heartbeat_max_misses) == \
+        (2, 0.5, 7)
+    assert cfg.chaos == "seed=3;drop=0.1;delay=5ms~10ms"
+
+    with pytest.raises(ValueError, match="quorum"):
+        Config(quorum=0)
+    with pytest.raises(ValueError, match="straggler_soft_s"):
+        Config(straggler_soft_s=0)
+    with pytest.raises(ValueError, match="heartbeat_max_misses"):
+        Config(heartbeat_max_misses=0)
+    with pytest.raises(ValueError):
+        Config(chaos="drop=2.0")  # not a probability
+    with pytest.raises(ValueError):
+        Config(chaos="frobnicate=1")  # unknown key
+
+
+# -- predict (Forward fan-out) quorum hedging ---------------------------------
+
+
+def test_predict_quorum_hedges_straggler_slice(data, model_fn):
+    """evaluate's fan-out: a straggling worker's Forward slice is hedged
+    to a fast worker — full coverage (every sample predicted), no eviction,
+    and the answer matches the quorum-less fan-out exactly."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        w = np.zeros(train.n_features, dtype=np.float32)
+        want = c.master.predict(w, timeout_s=30.0)
+        victim = c.workers[0]
+        orig = victim.compute_forward
+
+        def slow(wv, ids, _orig=orig):
+            time.sleep(1.0)
+            return _orig(wv, ids)
+
+        victim.compute_forward = slow
+        t0 = time.monotonic()
+        got = c.master.predict(w, timeout_s=30.0, quorum=1,
+                               straggler_soft_s=0.1)
+        assert time.monotonic() - t0 < 20.0
+        assert len(c.master._workers) == 2
+    np.testing.assert_array_equal(got, want)

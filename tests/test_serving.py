@@ -203,6 +203,47 @@ def test_model_store_loads_and_hot_swaps(tmp_path):
     store.stop()
 
 
+def test_model_store_failed_reload_keeps_serving_last_good(tmp_path):
+    """Graceful degradation (docs/FAULT_TOLERANCE.md): a failed
+    Checkpointer.reload()/restore mid-traffic must keep serving the
+    last-good weights and count serve.model.reload.errors — not poison
+    the published snapshot — and a later healthy poll recovers."""
+    from distributed_sgd_tpu.serving.model_store import ModelStore
+
+    w1 = np.arange(8, dtype=np.float32)
+    _save(tmp_path, 1, w1)
+    m = Metrics()
+    store = ModelStore(str(tmp_path), poll_s=30.0, metrics=m)
+    assert store.step == 1
+
+    # the poll races a half-committed write: reload() blows up
+    real_reload = store._ckpt.reload
+    store._ckpt.reload = lambda: (_ for _ in ()).throw(OSError("torn write"))
+    assert not store.poll_once()
+    step, w = store.get()  # still the last-good snapshot, not None
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(w), w1)
+    assert m.counter("serve.model.reload.errors").value == 1
+
+    # a corrupt restore AFTER a successful listing must not poison either
+    store._ckpt.reload = real_reload
+    _save(tmp_path, 2, w1 * 2)
+    real_restore = store._ckpt.restore_latest
+    store._ckpt.restore_latest = lambda: (_ for _ in ()).throw(
+        ValueError("corrupt snapshot"))
+    assert not store.poll_once()
+    assert store.step == 1
+    assert m.counter("serve.model.reload.errors").value == 2
+
+    # the next healthy poll recovers to the new checkpoint
+    store._ckpt.restore_latest = real_restore
+    assert store.poll_once()
+    step, w = store.get()
+    assert step == 2
+    np.testing.assert_array_equal(np.asarray(w), w1 * 2)
+    store.stop()
+
+
 def test_model_store_empty_directory_serves_nothing(tmp_path):
     from distributed_sgd_tpu.serving.model_store import ModelStore
 

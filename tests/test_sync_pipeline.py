@@ -91,15 +91,18 @@ def test_knobs_off_requests_carry_no_pipeline_fields(data, model_fn):
                 seen.append((request.HasField("weights"),
                              request.HasField("delta"),
                              request.step_version, request.local_steps,
-                             request.batch_size, request.learning_rate))
+                             request.batch_size, request.learning_rate,
+                             request.ef_rollback_version, request.hedge))
                 return _orig(request)
 
             w.resolve_request_weights = spy
         _fit(c, max_epochs=1)
     assert seen, "no Gradient request observed"
-    for has_w, has_d, ver, k, bs, lr in seen:
+    for has_w, has_d, ver, k, bs, lr, rb, hedge in seen:
         assert has_w and not has_d
         assert ver == 0 and k == 0 and bs == 0 and lr == 0.0
+        # quorum surface (DSGD_QUORUM off): both fields absent too
+        assert rb == 0 and not hedge
 
 
 def test_rounds_counter_and_window_span(data, model_fn):
