@@ -318,6 +318,14 @@ def main() -> None:
 
         bench_rpc_sync.main(smoke="--smoke" in sys.argv)
         return
+    if "--trace-overhead" in sys.argv:
+        # tracing-overhead gate (docs/OBSERVABILITY.md): the rpc sync
+        # workload with the tracer unconfigured vs fully on (sample=1.0);
+        # hard-asserts <5% overhead.  --smoke is the CI-sized mode.
+        from benches import bench_trace
+
+        bench_trace.main(smoke="--smoke" in sys.argv)
+        return
     if "--chaos" in sys.argv:
         # chaos gate (docs/FAULT_TOLERANCE.md): sync training under the
         # canonical seeded fault plan, quorum on vs off — asserts

@@ -58,6 +58,9 @@ from typing import Dict, List, Optional, Tuple
 
 import grpc
 
+from distributed_sgd_tpu import trace as trace_mod
+from distributed_sgd_tpu.trace import flight
+
 _DUR_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)?$")
 
 
@@ -375,9 +378,16 @@ class ChaosState:
                 self._rngs[key] = r
             return r
 
-    def count(self, kind: str) -> None:
+    def count(self, kind: str, **edge) -> None:
+        """Account one injected fault.  `edge` (method/origin/target) flows
+        into the flight recorder and — when the caller sits inside a
+        sampled trace span, e.g. a master fan-out window — into the trace
+        as an instant event, so an injected delay is visibly ATTRIBUTED in
+        the timeline instead of masquerading as a slow worker."""
         if self._metrics is not None:
             self._metrics.counter(f"chaos.injected.{kind}").increment()
+        flight.record(f"chaos.{kind}", **edge)
+        trace_mod.event(f"chaos.{kind}", **edge)
 
 
 class _ChaosCallable:
@@ -403,20 +413,23 @@ class _ChaosCallable:
         u_err = rng.random()
         u_dup = rng.random()
         d = (rng.uniform(*st.plan.delay) if st.plan.delay is not None else 0.0)
+        edge = {"method": self._method,
+                "origin": st._canonical(self._origin),
+                "target": st._canonical(self._target)}
         if st.partitioned(self._target, self._origin):
-            st.count("partition")
+            st.count("partition", **edge)
             return ("drop", None)
         if u_drop < st.plan.drop:
-            st.count("drop")
+            st.count("drop", **edge)
             return ("drop", None)
         if u_err < st.plan.error:
-            st.count("error")
+            st.count("error", **edge)
             return ("error", None)
         if u_dup < st.plan.dup:
-            st.count("dup")
+            st.count("dup", delay_s=round(d, 6), **edge)
             return ("dup", d)
         if d > 0:
-            st.count("delay")
+            st.count("delay", delay_s=round(d, 6), **edge)
             return ("delay", d)
         return ("pass", None)
 

@@ -49,11 +49,14 @@ class Checkpointer:
         )
 
     def save(self, step: int, weights, extra: Optional[Dict[str, Any]] = None) -> bool:
-        state = {"weights": np.asarray(weights)}
-        if extra:
-            state.update({k: np.asarray(v) for k, v in extra.items()})
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
-        self._mgr.wait_until_finished()
+        from distributed_sgd_tpu.utils.measure import span
+
+        with span("ckpt.save", step=step):
+            state = {"weights": np.asarray(weights)}
+            if extra:
+                state.update({k: np.asarray(v) for k, v in extra.items()})
+            saved = self._mgr.save(step, args=ocp.args.StandardSave(state))
+            self._mgr.wait_until_finished()
         if saved:
             log.info("checkpoint saved at step %d -> %s", step, self.directory)
         else:  # orbax declines e.g. writes to an already-existing step
@@ -74,6 +77,8 @@ class Checkpointer:
         self._mgr.reload()
 
     def restore_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        from distributed_sgd_tpu.utils.measure import span
+
         step = self._mgr.latest_step()
         if step is None:
             return None
@@ -81,7 +86,8 @@ class Checkpointer:
         # manager that already SAVED this process (saving registers the item
         # handler as a side effect) — a restore-only process (resume at
         # startup, the serving hot-reload poll) needs the args spelled out
-        state = self._mgr.restore(step, args=ocp.args.StandardRestore())
+        with span("ckpt.restore", step=step):
+            state = self._mgr.restore(step, args=ocp.args.StandardRestore())
         state["weights"] = jnp.asarray(state["weights"])
         return step, state
 

@@ -80,6 +80,23 @@ class Config:
     # "seed=7;drop=0.05;delay=20ms~200ms;dup=0.01;partition=w2:10s@30s";
     # None/empty = no injection (and no wrapping at all)
     chaos: Optional[str] = None
+    # -- distributed tracing + flight recorder (docs/OBSERVABILITY.md) -----
+    # trace: per-round span timelines across master/worker/serving with
+    # Chrome/Perfetto export (trace/).  Default off; the off path is a
+    # provably zero-cost no-op (no span objects are ever allocated) and
+    # the wire stays byte-identical either way (context rides gRPC
+    # metadata, never the proto).
+    trace: bool = False
+    # per-process trace files land here (also the flight-recorder dump
+    # dir); None with trace=1 defaults to ./dsgd-traces
+    trace_dir: Optional[str] = None
+    # per-trace_id head sampling in [0, 1]: a sampled round is traced end
+    # to end on every node; 1.0 = trace everything
+    trace_sample: float = 1.0
+    # flight recorder ring capacity (events kept per process for the
+    # post-mortem dumps: SIGUSR2, eviction, below-quorum, loop crash);
+    # 0 disables recording entirely
+    flight_recorder: int = 512
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     # InfluxDB write endpoint for the push reporter (reference parity:
     # Kamon InfluxDBReporter, application.conf:54-78), e.g.
@@ -164,6 +181,10 @@ class Config:
             from distributed_sgd_tpu.chaos import parse_plan
 
             parse_plan(self.chaos)
+        if not 0.0 <= self.trace_sample <= 1.0:
+            raise ValueError("trace_sample must be a probability in [0, 1]")
+        if self.flight_recorder < 0:
+            raise ValueError("flight_recorder must be >= 0 (0 disables)")
         if self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
         if self.steps_per_dispatch < 1:
@@ -261,6 +282,11 @@ class Config:
             quorum=_env("DSGD_QUORUM", None, int),
             straggler_soft_s=_env("DSGD_STRAGGLER_SOFT_S", None, float),
             chaos=_env("DSGD_CHAOS", None, str),
+            trace=_env("DSGD_TRACE", cls.trace, bool),
+            trace_dir=_env("DSGD_TRACE_DIR", None, str),
+            trace_sample=_env("DSGD_TRACE_SAMPLE", cls.trace_sample, float),
+            flight_recorder=_env("DSGD_FLIGHT_RECORDER",
+                                 cls.flight_recorder, int),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             influx_url=_env("DSGD_INFLUX_URL", None, str),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),

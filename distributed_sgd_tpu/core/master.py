@@ -50,6 +50,8 @@ from distributed_sgd_tpu.rpc.service import (
     new_channel,
     new_server,
 )
+from distributed_sgd_tpu import trace as trace_mod
+from distributed_sgd_tpu.trace import flight
 from distributed_sgd_tpu.utils import metrics as metrics_mod
 from distributed_sgd_tpu.utils.log import node_logger
 
@@ -398,7 +400,7 @@ class MasterNode:
 
         self.server = new_server(port, host="0.0.0.0")
         self.port = self.port or self.server.bound_port
-        add_master_servicer(self.server, _MasterServicer(self))
+        add_master_servicer(self.server, _MasterServicer(self), node="master")
 
         # heartbeat failure detection (superset; SURVEY.md §5.3: the
         # reference has none and a dead worker hangs the sync barrier)
@@ -462,7 +464,7 @@ class MasterNode:
                                      n, max_failures, *key)
                     if evict:
                         self.log.warning("worker %s:%d declared dead", *key)
-                        self.unregister_worker(*key)
+                        self.unregister_worker(*key, evicted=True)
 
     def stop(self) -> None:
         self._hb_stop.set()
@@ -519,8 +521,16 @@ class MasterNode:
         if count >= self.expected_workers:
             self.cluster_ready.set()  # Master.scala:235-241
 
-    def unregister_worker(self, host: str, port: int) -> None:
+    def unregister_worker(self, host: str, port: int,
+                          evicted: bool = False) -> None:
+        """`evicted=True` marks an involuntary removal (heartbeat miss,
+        Gradient/Forward failure threshold, async watchdog) — those dump
+        the flight recorder so a dead worker leaves post-mortem evidence
+        even with tracing off; a graceful leave does not."""
         key = (host, port)
+        if evicted:
+            flight.record("worker.evicted", worker=f"{host}:{port}")
+            flight.dump("eviction")
         with self._members_lock:
             self._workers.pop(key, None)
             ch = self._channels.pop(key, None)
@@ -584,25 +594,29 @@ class MasterNode:
                 raise RuntimeError("all workers lost during predict")
             parts = split(len(self.train), len(members))
             part_by_key = {key: ids for (key, _), ids in zip(members, parts)}
-            futs = []
-            for (key, stub), ids in zip(members, parts):
-                try:
-                    fut = stub.Forward.future(
-                        pb.ForwardRequest(
-                            samples=ids.astype(np.int32), weights=wmsg,
-                            want_margins=return_margins,
-                        ),
-                        timeout=timeout_s,
-                    )
-                except ValueError:
-                    fut = None
-                futs.append((key, fut))
-            if quorum is None:
-                ok, failed = _await_futures(futs)
-            else:
-                ok, failed = self._forward_quorum(
-                    futs, members, part_by_key, quorum, straggler_soft_s,
-                    timeout_s, wmsg, return_margins)
+            # one trace per eval fan-out attempt (trace/): Forward calls
+            # and their hedges become child spans, same as fit_sync windows
+            with trace_mod.root_span(trace_mod.SPAN_EVAL_FORWARD,
+                                     node="master", workers=len(members)):
+                futs = []
+                for (key, stub), ids in zip(members, parts):
+                    try:
+                        fut = stub.Forward.future(
+                            pb.ForwardRequest(
+                                samples=ids.astype(np.int32), weights=wmsg,
+                                want_margins=return_margins,
+                            ),
+                            timeout=timeout_s,
+                        )
+                    except ValueError:
+                        fut = None
+                    futs.append((key, fut))
+                if quorum is None:
+                    ok, failed = _await_futures(futs)
+                else:
+                    ok, failed = self._forward_quorum(
+                        futs, members, part_by_key, quorum, straggler_soft_s,
+                        timeout_s, wmsg, return_margins)
             if not failed:
                 out = np.zeros(len(self.train), dtype=np.float32)
                 margins = np.zeros(len(self.train), dtype=np.float32)
@@ -624,7 +638,7 @@ class MasterNode:
                 if evict:
                     self.log.warning("worker %s:%d failed Forward %d times (%s); "
                                      "declaring dead", key[0], key[1], n, code)
-                    self.unregister_worker(*key)
+                    self.unregister_worker(*key, evicted=True)
                 else:
                     self.log.warning("worker %s:%d failed Forward (%s); retry %d/%d",
                                      key[0], key[1], code, n, retries)
@@ -664,6 +678,9 @@ class MasterNode:
                     continue
                 hedges.append((skey, hfut))
                 self.metrics.counter(metrics_mod.QUORUM_HEDGES).increment()
+                trace_mod.event(trace_mod.EVENT_QUORUM_HEDGE,
+                                straggler=f"{skey[0]}:{skey[1]}",
+                                donor=f"{donor[0]}:{donor[1]}")
                 self.log.info("hedging Forward slice of straggler %s:%d "
                               "on %s:%d", *skey, *donor)
             h_ok, _h_failed = _await_futures(hedges)
@@ -922,122 +939,148 @@ class MasterNode:
                     if batch >= max_samples:
                         break
                 t_batch = time.perf_counter()
-                futs = []
-                ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
-                rb_sent: Dict[Tuple[str, int], int] = {}
-                for (key, stub), part in zip(members, parts):
-                    ids = _draw_ids(rng, part, batch, window_span)
-                    ids_by_key[key] = ids
-                    req = pb.GradientRequest(
-                        samples=ids.astype(np.int32), fit_token=fit_token)
-                    if local_steps > 1:
-                        req.local_steps = local_steps
-                        req.batch_size = batch_size
-                        req.learning_rate = learning_rate
-                    rb = ef_rollback.pop(key, None)
-                    if rb is not None:
-                        req.ef_rollback_version = rb
-                        rb_sent[key] = rb  # re-armed if this request fails
-                    bcast.populate(req, key, w)
-                    try:
-                        fut = stub.Gradient.future(req, timeout=grad_timeout_s)
-                    except ValueError:  # channel closed under us
-                        fut = None
-                    futs.append((key, fut))
-                if quorum is None:
-                    # barrier, with deadlines; receive-side wire accounting
-                    # happens per arriving reply inside _await_futures (send-
-                    # side comms.* counters live in the workers' compressors),
-                    # so discarded/retried windows are accounted too
-                    ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
-                    good, stale = [], []
-                    for key, reply in ok:
-                        (stale if reply.stale_version else good).append((key, reply))
-                    replies = [r for _, r in good]
-                    satisfied = False
-                    # pure observation when a soft deadline is configured
-                    # without quorum: how often would the quorum barrier
-                    # have had to intervene?  (bench_chaos.py's baseline)
-                    if (straggler_soft_s is not None
-                            and time.perf_counter() - t_batch > straggler_soft_s):
-                        stalled.increment()
-                else:
-                    replies, good, stale, failed, satisfied = (
-                        self._quorum_barrier(
-                            futs, members, ids_by_key, quorum,
-                            straggler_soft_s, grad_timeout_s, fit_token,
-                            local_steps, batch_size, learning_rate, bcast,
-                            w, hedge, ef_rollback, grad_bytes, rb_sent))
-                rounds.increment()
-                for key, _ in good:
-                    tracker.record_ok(key)
-                    bcast.note_ok(key)
-                for key, _ in stale:
-                    # a stale reply is still a LIVE worker: reset its
-                    # failure count (the pre-quorum code treated every ok
-                    # reply as liveness evidence)
-                    tracker.record_ok(key)
-                    # replica mismatch (restart, missed window): full
-                    # broadcast on the retry — the correctness fallback
-                    bcast.note_stale(key)
-                    self.metrics.counter(metrics_mod.SYNC_STALE).increment()
-                    self.log.warning(
-                        "worker %s:%d replica stale at v%d; falling back to "
-                        "full broadcast", key[0], key[1], bcast.version)
-                if not satisfied:
-                    if failed:
-                        for key, code in failed:
-                            n, evict = tracker.record_failure(key)
-                            if not evict:
+                # one trace per fan-out window (trace/; NOOP when tracing
+                # is off or this round is not head-sampled): worker
+                # Gradient calls — hedges and retries included — become
+                # client/server child spans of this root via the stub and
+                # servicer hooks in rpc/service.py, and quorum/chaos
+                # events attach inside it (docs/OBSERVABILITY.md)
+                wspan = trace_mod.root_span(
+                    trace_mod.SPAN_SYNC_WINDOW, node="master", epoch=epoch,
+                    batch=int(batch), version=bcast.version)
+                with wspan:
+                    futs = []
+                    ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
+                    rb_sent: Dict[Tuple[str, int], int] = {}
+                    for (key, stub), part in zip(members, parts):
+                        ids = _draw_ids(rng, part, batch, window_span)
+                        ids_by_key[key] = ids
+                        req = pb.GradientRequest(
+                            samples=ids.astype(np.int32), fit_token=fit_token)
+                        if local_steps > 1:
+                            req.local_steps = local_steps
+                            req.batch_size = batch_size
+                            req.learning_rate = learning_rate
+                        rb = ef_rollback.pop(key, None)
+                        if rb is not None:
+                            req.ef_rollback_version = rb
+                            rb_sent[key] = rb  # re-armed if this request fails
+                        bcast.populate(req, key, w)
+                        try:
+                            fut = stub.Gradient.future(req, timeout=grad_timeout_s)
+                        except ValueError:  # channel closed under us
+                            fut = None
+                        futs.append((key, fut))
+                    if quorum is None:
+                        # barrier, with deadlines; receive-side wire accounting
+                        # happens per arriving reply inside _await_futures (send-
+                        # side comms.* counters live in the workers' compressors),
+                        # so discarded/retried windows are accounted too
+                        ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
+                        good, stale = [], []
+                        for key, reply in ok:
+                            (stale if reply.stale_version else good).append((key, reply))
+                        replies = [r for _, r in good]
+                        satisfied = False
+                        # pure observation when a soft deadline is configured
+                        # without quorum: how often would the quorum barrier
+                        # have had to intervene?  (bench_chaos.py's baseline)
+                        if (straggler_soft_s is not None
+                                and time.perf_counter() - t_batch > straggler_soft_s):
+                            stalled.increment()
+                    else:
+                        replies, good, stale, failed, satisfied = (
+                            self._quorum_barrier(
+                                futs, members, ids_by_key, quorum,
+                                straggler_soft_s, grad_timeout_s, fit_token,
+                                local_steps, batch_size, learning_rate, bcast,
+                                w, hedge, ef_rollback, grad_bytes, rb_sent))
+                        if not satisfied:
+                            # below-quorum degradation: the barrier fell back
+                            # to the classic full barrier — dump the flight
+                            # ring so the window leaves evidence even when
+                            # the fit later recovers (docs/OBSERVABILITY.md)
+                            flight.record(
+                                "quorum.below", epoch=epoch, batch=int(batch),
+                                version=bcast.version, got=len(good),
+                                quorum=min(quorum, len(members)))
+                            # throttled: a minutes-long partition degrades
+                            # EVERY window — keep evidence fresh without
+                            # blocking the barrier loop on disk each round
+                            flight.dump("below_quorum", min_interval_s=10.0)
+                    rounds.increment()
+                    for key, _ in good:
+                        tracker.record_ok(key)
+                        bcast.note_ok(key)
+                    for key, _ in stale:
+                        # a stale reply is still a LIVE worker: reset its
+                        # failure count (the pre-quorum code treated every ok
+                        # reply as liveness evidence)
+                        tracker.record_ok(key)
+                        # replica mismatch (restart, missed window): full
+                        # broadcast on the retry — the correctness fallback
+                        bcast.note_stale(key)
+                        self.metrics.counter(metrics_mod.SYNC_STALE).increment()
+                        trace_mod.event(trace_mod.EVENT_BCAST_STALE,
+                                        worker=f"{key[0]}:{key[1]}")
+                        self.log.warning(
+                            "worker %s:%d replica stale at v%d; falling back to "
+                            "full broadcast", key[0], key[1], bcast.version)
+                    if not satisfied:
+                        if failed:
+                            for key, code in failed:
+                                n, evict = tracker.record_failure(key)
+                                if not evict:
+                                    self.log.warning(
+                                        "worker %s:%d failed Gradient (%s); retry %d/%d",
+                                        key[0], key[1], code, n, grad_retries)
+                                    continue
+                                if on_worker_death == "fail":
+                                    # abort WITHOUT mutating membership: the caller
+                                    # chose to investigate, not to continue degraded
+                                    raise RuntimeError(
+                                        f"worker {key[0]}:{key[1]} died mid-fit "
+                                        f"({n} consecutive Gradient failures: {code})")
                                 self.log.warning(
-                                    "worker %s:%d failed Gradient (%s); retry %d/%d",
-                                    key[0], key[1], code, n, grad_retries)
-                                continue
-                            if on_worker_death == "fail":
-                                # abort WITHOUT mutating membership: the caller
-                                # chose to investigate, not to continue degraded
-                                raise RuntimeError(
-                                    f"worker {key[0]}:{key[1]} died mid-fit "
-                                    f"({n} consecutive Gradient failures: {code})")
-                            self.log.warning(
-                                "worker %s:%d failed Gradient %d times (%s); declaring dead",
-                                key[0], key[1], n, code)
-                            self.unregister_worker(*key)
-                    if failed or stale:
-                        continue  # retry this window (survivors or re-split)
-                # allocation-free fan-in: scatter/add every reply into the
-                # preallocated accumulator, then scale once — replaces the
-                # per-window [decode_grad(r) for r in ok] dense stack +
-                # np.mean (Vec.mean, Master.scala:194).  Under a satisfied
-                # quorum `replies` holds the actual contributors (own + hedge
-                # replies) and the mean over |contributors| is the unbiased
-                # 1/|ok| scaling of Chen et al. 2016's backup-worker rule.
-                grad_acc.fill(0.0)
-                for reply in replies:
-                    codec.decode_grad_into(reply, grad_acc)
-                grad_acc /= len(replies)  # true divide, bit-matching np.mean
-                w_old = w
-                if local_steps > 1:
-                    # replies are summed weight-space decrements; apply the
-                    # mean as a pseudo-gradient through the same optimizer
-                    # surface (error-feedback discipline of local SGD)
-                    if opt is None:
-                        w = w - grad_acc
+                                    "worker %s:%d failed Gradient %d times (%s); declaring dead",
+                                    key[0], key[1], n, code)
+                                self.unregister_worker(*key, evicted=True)
+                        if failed or stale:
+                            wspan.set(retry=True)
+                            continue  # retry this window (survivors or re-split)
+                    # allocation-free fan-in: scatter/add every reply into the
+                    # preallocated accumulator, then scale once — replaces the
+                    # per-window [decode_grad(r) for r in ok] dense stack +
+                    # np.mean (Vec.mean, Master.scala:194).  Under a satisfied
+                    # quorum `replies` holds the actual contributors (own + hedge
+                    # replies) and the mean over |contributors| is the unbiased
+                    # 1/|ok| scaling of Chen et al. 2016's backup-worker rule.
+                    grad_acc.fill(0.0)
+                    for reply in replies:
+                        codec.decode_grad_into(reply, grad_acc)
+                    grad_acc /= len(replies)  # true divide, bit-matching np.mean
+                    w_old = w
+                    if local_steps > 1:
+                        # replies are summed weight-space decrements; apply the
+                        # mean as a pseudo-gradient through the same optimizer
+                        # surface (error-feedback discipline of local SGD)
+                        if opt is None:
+                            w = w - grad_acc
+                        else:
+                            w_j, opt_state = _opt_step(
+                                jnp.asarray(w), opt_state,
+                                jnp.asarray(grad_acc) / learning_rate)
+                            w = np.asarray(w_j)
+                    elif opt is None:
+                        w = w - learning_rate * grad_acc  # Master.scala:197
                     else:
                         w_j, opt_state = _opt_step(
-                            jnp.asarray(w), opt_state,
-                            jnp.asarray(grad_acc) / learning_rate)
+                            jnp.asarray(w), opt_state, jnp.asarray(grad_acc))
                         w = np.asarray(w_j)
-                elif opt is None:
-                    w = w - learning_rate * grad_acc  # Master.scala:197
-                else:
-                    w_j, opt_state = _opt_step(
-                        jnp.asarray(w), opt_state, jnp.asarray(grad_acc))
-                    w = np.asarray(w_j)
-                bcast.advance(w, w_old)
-                self.metrics.histogram("master.sync.batch.duration").record(
-                    time.perf_counter() - t_batch)
-                batch += window_span
+                    bcast.advance(w, w_old)
+                    self.metrics.histogram("master.sync.batch.duration").record(
+                        time.perf_counter() - t_batch)
+                    batch += window_span
             epoch_s = time.perf_counter() - t0
 
             loss, acc = self.local_loss(w)
@@ -1111,6 +1154,10 @@ class MasterNode:
         # headline counts exactly these.
         if time.monotonic() - t0 > soft_s + max(0.05, 0.25 * soft_s):
             self.metrics.counter(metrics_mod.SYNC_STALLED).increment()
+            trace_mod.event(trace_mod.EVENT_BARRIER_STALLED,
+                            soft_s=round(soft_s, 4), got=len(ok))
+            flight.record("barrier.stalled", soft_s=round(soft_s, 4),
+                          got=len(ok), quorum=quorum_n)
         good, stale = [], []
         for key, reply in ok:
             (stale if reply.stale_version else good).append((key, reply))
@@ -1146,6 +1193,12 @@ class MasterNode:
                     continue
                 hedge_futs.append((skey, hfut))
                 self.metrics.counter(metrics_mod.QUORUM_HEDGES).increment()
+                trace_mod.event(trace_mod.EVENT_QUORUM_HEDGE,
+                                straggler=f"{skey[0]}:{skey[1]}",
+                                donor=f"{donor[0]}:{donor[1]}")
+                flight.record("quorum.hedge",
+                              straggler=f"{skey[0]}:{skey[1]}",
+                              donor=f"{donor[0]}:{donor[1]}")
                 self.log.info(
                     "hedging slice of straggler %s:%d on %s:%d", *skey, *donor)
             h_ok, _h_failed = _await_futures(hedge_futs,
@@ -1186,8 +1239,16 @@ class MasterNode:
         if len(replies) >= quorum_n:
             if len(good) < len(ids_by_key):
                 self.metrics.counter(metrics_mod.QUORUM_DEGRADED).increment()
-            for _ in hedge_wins:
+                missing = [f"{k[0]}:{k[1]}" for k in ids_by_key
+                           if k not in own]
+                trace_mod.event(trace_mod.EVENT_QUORUM_DEGRADED,
+                                contributors=len(replies), missing=missing)
+                flight.record("quorum.degraded", contributors=len(replies),
+                              missing=missing)
+            for skey, _ in hedge_wins:
                 self.metrics.counter(metrics_mod.QUORUM_HEDGE_WINS).increment()
+                trace_mod.event(trace_mod.EVENT_QUORUM_HEDGE_WIN,
+                                straggler=f"{skey[0]}:{skey[1]}")
             # contribution mask: every fanned-out worker whose own reply
             # was NOT used rolls its EF drain back on the next request
             # (exact-match on the broadcast version, so a worker that
@@ -1204,10 +1265,18 @@ class MasterNode:
                         ef_rollback[key] = rb_sent[key]
                     else:
                         ef_rollback[key] = bcast.version
+            # the late settle runs on a gRPC callback thread after this
+            # window's span closed: capture the window context NOW so the
+            # discard still lands in the round's timeline
+            w_ctx = trace_mod.current()
             for key, fut in still_pending:
-                def _count_late(f, _c=late_counter):
+                def _count_late(f, _c=late_counter, _k=key):
                     if not f.cancelled():
                         _c.increment()
+                        trace_mod.event_in(
+                            w_ctx, trace_mod.EVENT_QUORUM_LATE,
+                            node="master", worker=f"{_k[0]}:{_k[1]}")
+                        flight.record("quorum.late", worker=f"{_k[0]}:{_k[1]}")
                 fut.add_done_callback(_count_late)
             # stragglers are NOT failures: no tracker/eviction pressure
             # from a quorum-satisfied round
@@ -1477,7 +1546,7 @@ class MasterNode:
                 self.log.warning(
                     "async watchdog: worker %s:%d unresponsive (%s); "
                     "declaring dead", key[0], key[1], code)
-                self.unregister_worker(*key)
+                self.unregister_worker(*key, evicted=True)
                 dead.append(key)
         if not dead:
             survivors = list(assignments)
@@ -1534,7 +1603,7 @@ class MasterNode:
             self.log.warning(
                 "async fit: StartAsync re-issue to %s:%d failed (%s); "
                 "evicting — samples reassign next tick", key[0], key[1], code)
-            self.unregister_worker(*key)
+            self.unregister_worker(*key, evicted=True)
 
     # master UpdateGrad RPC (MasterAsync.scala:164-177); one gossip message
     # may carry n_steps summed local steps (dispatch amortization) and

@@ -30,6 +30,7 @@ from distributed_sgd_tpu.core.grad_state import GradState
 from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
 from distributed_sgd_tpu.parallel.sync import BoundSync, SyncEngine
+from distributed_sgd_tpu.utils import measure
 from distributed_sgd_tpu.utils import metrics as metrics_mod
 
 log = logging.getLogger("dsgd.trainer")
@@ -161,7 +162,12 @@ class SyncTrainer:
             # keyed by absolute epoch index: a resumed run continues the same
             # batch-sampling stream instead of replaying epochs 0..N-1's keys
             ek = jax.random.fold_in(base_key, epoch)
-            with self.metrics.timer("master.sync.batch.duration"):
+            # measure.span feeds BOTH the histogram exporters and (when
+            # DSGD_TRACE is on) a trace span per epoch — the mesh engine
+            # has no per-window RPC spans, so the epoch is its trace unit
+            with measure.span("trainer.epoch", metrics=self.metrics,
+                              node="trainer", epoch=epoch), \
+                    self.metrics.timer("master.sync.batch.duration"):
                 w = bound_train.epoch(w, ek)
                 jax.block_until_ready(w)
             epoch_s = time.perf_counter() - t0

@@ -38,6 +38,9 @@ from distributed_sgd_tpu.rpc.service import (
     new_channel,
     new_server,
 )
+from distributed_sgd_tpu import trace as trace_mod
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import measure
 from distributed_sgd_tpu.utils import metrics as metrics_mod
 from distributed_sgd_tpu.utils.log import node_logger
 
@@ -69,6 +72,8 @@ class WorkerNode:
         compress_k: float = 0.01,
         compress_ef: bool = True,
         rpc_policy: Optional[RpcPolicy] = None,
+        profile_dir: Optional[str] = None,
+        profile_steps: int = 16,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -157,9 +162,25 @@ class WorkerNode:
         self._apply = jax.jit(lambda w, d: w - d)
         self._grad_cache: Dict[int, callable] = {}  # keyed by padded capacity
 
-        add_worker_servicer(self.server, _WorkerServicer(self))
+        # DSGD_PROFILE_DIR on the RPC worker role: a jax.profiler capture
+        # of the FIRST `profile_steps` device dispatches (Gradient bodies
+        # or async-loop steps) — this is where the distributed wall-clock
+        # actually goes, which the trainer-only wiring never saw
+        # (docs/OBSERVABILITY.md).  Thread-safe inside ProfileWindow:
+        # dispatches arrive on gRPC servicer threads and the async loop
+        # concurrently.
+        self._profile = measure.ProfileWindow(profile_dir, profile_steps,
+                                              logger=self.log)
+
+        add_worker_servicer(self.server, _WorkerServicer(self),
+                            node=self.node_label)
         self._registered = threading.Event()
         self._stopped = threading.Event()
+
+    @property
+    def node_label(self) -> str:
+        """Stable identity for trace spans and flight events."""
+        return f"{self.host}:{self.port}"
 
     # -- lifecycle (Slave.scala:40-77) -------------------------------------
 
@@ -195,6 +216,7 @@ class WorkerNode:
         self._running_async.clear()
         if self._async_thread is not None:
             self._async_thread.join()
+        self._profile.close()
         if self._registered.is_set():
             try:
                 self._master.UnregisterSlave(
@@ -294,6 +316,7 @@ class WorkerNode:
     def compute_gradient(self, w: np.ndarray, ids: np.ndarray) -> np.ndarray:
         """Sync Gradient RPC body: sum of backwards + regularize
         (Slave.scala:142-157)."""
+        self._profile.tick()
         pids, valid = self._pad_ids(ids)
         g = self._grad_fn(len(pids))(
             jnp.asarray(w), self._idx, self._val, self._y, pids, valid
@@ -383,6 +406,7 @@ class WorkerNode:
         The final (or only) batch may be short — epoch tails send fewer
         than k*batch_size ids — and is masked out via zeroed rows, so each
         (steps, batch_size) shape compiles exactly once."""
+        self._profile.tick()
         bs = max(1, int(batch_size))
         n = len(ids)
         # step count derives from the ids actually sent, capped at k so an
@@ -467,6 +491,9 @@ class WorkerNode:
                 self._compressor.residual_restore("sync:master", prev_res)
                 self._sync_ef_guard = (None, None)
                 self.metrics.counter("slave.sync.ef.rollback").increment()
+                trace_mod.event(trace_mod.EVENT_EF_ROLLBACK, version=version)
+                flight.record("ef.rollback", worker=self.node_label,
+                              version=version)
 
     def compute_forward(self, w: np.ndarray, ids: np.ndarray):
         """Forward RPC body (Slave.scala:129-140) -> (predictions, margins).
@@ -538,6 +565,19 @@ class WorkerNode:
         self.metrics.counter("slave.async.grad.update").increment()
 
     def _async_loop(self) -> None:
+        # the loop thread is a daemon: an uncaught exception here would
+        # kill Hogwild training SILENTLY (the master's stall watchdog only
+        # notices minutes later) — leave post-mortem evidence first
+        try:
+            self._async_loop_impl()
+        except Exception as e:  # noqa: BLE001 - record, dump, then surface
+            flight.record("async.loop.crash", worker=self.node_label,
+                          error=repr(e))
+            flight.dump("exception")
+            self.log.exception("async loop crashed")
+            raise
+
+    def _async_loop_impl(self) -> None:
         bs, lr = self._async_bs, self._async_lr
         n_assigned = int(self._assignment.shape[0])
         model = self.model
@@ -576,6 +616,7 @@ class WorkerNode:
         opt_state = opt.init(self._w) if opt is not None else None
         while self._running_async.is_set():
             key, k = jax.random.split(key)
+            self._profile.tick()
             snapshot = self._w  # stale read is the algorithm
             delta, opt_state = kstep(
                 snapshot, opt_state, self._assignment, self._idx, self._val,
@@ -584,47 +625,56 @@ class WorkerNode:
                 self._w = self._apply(self._w, delta)
             self.metrics.counter("slave.async.batch").increment(ksteps)
             delta_np = np.asarray(delta)
-            if self._compressor is None:
-                msg = codec.encode_grad(delta_np)
-                msg.n_steps = ksteps
-                with self._peers_lock:
-                    senders = list(self._gossip.values())
-                for sender in senders:  # fire-and-forget (Slave.scala:103-105),
-                    sender.send(msg)    # bounded in-flight, drop-oldest
-                self._master_gossip.send(msg)
-            else:
-                # per-destination encode: each peer (and the master) has its
-                # own error-feedback residual, so the k coordinates shipped
-                # can differ by destination.  Every message stays a plain
-                # weight-space delta, so the receiving merges keep the
-                # summed-delta commutativity contract above — EF only defers
-                # WHEN a coordinate's mass arrives, bounded by the residual.
-                # Note on transport drops: like the uncompressed wire, a
-                # gossip message the bounded sender cancels is simply lost
-                # (fire-and-forget permits it) — EF retransmits only what
-                # SELECTION dropped, never what the transport dropped; the
-                # loss stays bounded by one message per cancel, exactly as
-                # in the uncompressed mode (docs/COMPRESSION.md).
-                # Compress OUTSIDE _peers_lock (the first call jit-compiles
-                # the selection — holding the lock through that would stall
-                # Register/UnregisterSlave servicers); the post-loop sweep
-                # below closes the race where a concurrent remove_peer's
-                # residual_drop interleaves with an in-flight compress and
-                # the dropped entry gets silently re-created.
-                with self._peers_lock:
-                    senders_c = list(self._gossip.items())
-                for peer_key, sender in senders_c:
-                    msg = self._compressor.compress(
-                        delta_np, dest=("peer", peer_key))
-                    msg.n_steps = ksteps
-                    sender.send(msg)
-                msg = self._compressor.compress(delta_np, dest="master")
-                msg.n_steps = ksteps
-                self._master_gossip.send(msg)
-                with self._peers_lock:
-                    for peer_key, _ in senders_c:
-                        if peer_key not in self._gossip:
-                            self._compressor.residual_drop(("peer", peer_key))
+            # gossip fan-out span (trace/, one local trace per dispatch,
+            # head-sampled): encode + hand-off per destination — the sends
+            # themselves are fire-and-forget futures
+            with measure.span("slave.async.gossip", metrics=self.metrics,
+                              node=self.node_label, k=ksteps):
+                self._gossip_dispatch(delta_np, ksteps)
+
+    def _gossip_dispatch(self, delta_np: np.ndarray, ksteps: int) -> None:
+        """One dispatch's delta fan-out to every peer + the master."""
+        if self._compressor is None:
+            msg = codec.encode_grad(delta_np)
+            msg.n_steps = ksteps
+            with self._peers_lock:
+                senders = list(self._gossip.values())
+            for sender in senders:  # fire-and-forget (Slave.scala:103-105),
+                sender.send(msg)    # bounded in-flight, drop-oldest
+            self._master_gossip.send(msg)
+            return
+        # per-destination encode: each peer (and the master) has its
+        # own error-feedback residual, so the k coordinates shipped
+        # can differ by destination.  Every message stays a plain
+        # weight-space delta, so the receiving merges keep the
+        # summed-delta commutativity contract above — EF only defers
+        # WHEN a coordinate's mass arrives, bounded by the residual.
+        # Note on transport drops: like the uncompressed wire, a
+        # gossip message the bounded sender cancels is simply lost
+        # (fire-and-forget permits it) — EF retransmits only what
+        # SELECTION dropped, never what the transport dropped; the
+        # loss stays bounded by one message per cancel, exactly as
+        # in the uncompressed mode (docs/COMPRESSION.md).
+        # Compress OUTSIDE _peers_lock (the first call jit-compiles
+        # the selection — holding the lock through that would stall
+        # Register/UnregisterSlave servicers); the post-loop sweep
+        # below closes the race where a concurrent remove_peer's
+        # residual_drop interleaves with an in-flight compress and
+        # the dropped entry gets silently re-created.
+        with self._peers_lock:
+            senders_c = list(self._gossip.items())
+        for peer_key, sender in senders_c:
+            msg = self._compressor.compress(
+                delta_np, dest=("peer", peer_key))
+            msg.n_steps = ksteps
+            sender.send(msg)
+        msg = self._compressor.compress(delta_np, dest="master")
+        msg.n_steps = ksteps
+        self._master_gossip.send(msg)
+        with self._peers_lock:
+            for peer_key, _ in senders_c:
+                if peer_key not in self._gossip:
+                    self._compressor.residual_drop(("peer", peer_key))
 
 
 class _WorkerServicer:
@@ -665,11 +715,19 @@ class _WorkerServicer:
             return pb.GradUpdate(stale_version=True)
         ids = np.fromiter(request.samples, dtype=np.int64)
         k = request.local_steps
-        if k > 1:
-            g = self.w.compute_local_window(
-                w, ids, k, request.batch_size, request.learning_rate)
-        else:
-            g = self.w.compute_gradient(w, ids)
+        # compute vs encode/EF attribution (docs/OBSERVABILITY.md): under
+        # an active trace these become children of the Gradient server
+        # span (root=False: on an unsampled round they stay no-op rather
+        # than fabricating orphan traces); always they feed the span.*
+        # histograms
+        with measure.span("slave.grad.compute", metrics=self.w.metrics,
+                          root=False,
+                          samples=len(ids), local_steps=int(k or 1)):
+            if k > 1:
+                g = self.w.compute_local_window(
+                    w, ids, k, request.batch_size, request.learning_rate)
+            else:
+                g = self.w.compute_gradient(w, ids)
         if request.hedge:
             # straggler hedge (another worker's data slice): reply
             # uncompressed and leave this worker's OWN sync EF residual
@@ -684,16 +742,18 @@ class _WorkerServicer:
         # sync fan-in reply: compressed when configured (EF residual keyed
         # to the one sync destination — this worker answers one master),
         # with the retry-rollback + fit-session guards of encode_sync_grad
-        if self.w._compressor is not None:
-            # retry-window key: the step_version when the master versions
-            # its broadcasts (a retry repeats the version even if the wire
-            # form changed, e.g. full -> header-only after a mid-window
-            # fallback), the weight bytes otherwise (pre-pipeline wire:
-            # byte-identical weights = retry)
-            window_key = request.step_version or request.weights.data
-            msg = self.w.encode_sync_grad(g, window_key, request.fit_token)
-        else:
-            msg = codec.encode_grad(g)
+        with measure.span("slave.grad.encode", metrics=self.w.metrics,
+                          root=False):
+            if self.w._compressor is not None:
+                # retry-window key: the step_version when the master versions
+                # its broadcasts (a retry repeats the version even if the wire
+                # form changed, e.g. full -> header-only after a mid-window
+                # fallback), the weight bytes otherwise (pre-pipeline wire:
+                # byte-identical weights = retry)
+                window_key = request.step_version or request.weights.data
+                msg = self.w.encode_sync_grad(g, window_key, request.fit_token)
+            else:
+                msg = codec.encode_grad(g)
         if k > 1:
             msg.n_steps = k  # wire accounting: steps amortized per round
         return msg

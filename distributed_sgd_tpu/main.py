@@ -231,6 +231,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             kernel=cfg.kernel, virtual_workers=virtual,
             checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
             optimizer=cfg.optimizer, momentum=cfg.momentum,
+            profile_dir=cfg.profile_dir,
         )
         res = trainer.fit(train, test, cfg.max_epochs, criterion)
 
@@ -297,6 +298,26 @@ def main() -> None:
     log.info("config: %s", cfg.to_json())
     np.random.seed(cfg.seed)  # Main.scala:32 Random.setSeed(0)
 
+    # observability plumbing (docs/OBSERVABILITY.md), BEFORE any channel or
+    # server exists so every RPC edge is covered:
+    # - DSGD_TRACE: per-round span timelines, Chrome/Perfetto export
+    # - DSGD_FLIGHT_RECORDER: always-on post-mortem ring (SIGUSR2 dumps)
+    from distributed_sgd_tpu import trace as trace_mod
+    from distributed_sgd_tpu.trace import flight
+
+    role = cfg.role
+    trace_dir = cfg.trace_dir or ("dsgd-traces" if cfg.trace else None)
+    if cfg.trace:
+        trace_mod.configure(enabled=True, dir=trace_dir,
+                            sample=cfg.trace_sample,
+                            service=f"{role}-{cfg.port}")
+        log.info("tracing on: sample=%g dir=%s (merge with "
+                 "`python -m distributed_sgd_tpu.trace.merge %s`)",
+                 cfg.trace_sample, trace_dir, trace_dir)
+    flight.configure(capacity=cfg.flight_recorder,
+                     service=f"{role}-{cfg.port}", dir=trace_dir or ".")
+    flight.install_signal_handler()
+
     # record=true enables metric SHIPPING (the reference's Kamon reporter
     # flag, Main.scala:40-43); the transports are orthogonal and may both
     # run: DSGD_METRICS_PORT serves Prometheus pull, DSGD_INFLUX_URL pushes
@@ -320,12 +341,18 @@ def main() -> None:
                 "DSGD_RECORD=1 but neither DSGD_METRICS_PORT nor "
                 "DSGD_INFLUX_URL is set: metrics are collected but not shipped")
 
-    role = cfg.role
     try:
         _run_role(cfg, role)
+    except Exception:
+        # an uncaught exception in any engine loop that surfaces here
+        # leaves flight-recorder evidence before the process dies
+        flight.dump("exception")
+        raise
     finally:
         # stop + final flush on EVERY exit path: a crashed run's tail
-        # metrics (incl. metrics.push.errors) are the ones that matter
+        # metrics (incl. metrics.push.errors) are the ones that matter —
+        # same for the trace buffer
+        trace_mod.flush()
         if exporter is not None:
             exporter.stop()
         if pusher is not None:
@@ -414,6 +441,9 @@ def _run_role(cfg: Config, role: str) -> None:
             seed=cfg.seed, steps_per_dispatch=cfg.steps_per_dispatch,
             compress=cfg.compress, compress_k=cfg.compress_k,
             compress_ef=cfg.compress_ef,
+            # DSGD_PROFILE_DIR on the worker role: device trace of the
+            # first dispatches — where distributed time actually goes
+            profile_dir=cfg.profile_dir,
         ).start()
         worker.await_termination()
 

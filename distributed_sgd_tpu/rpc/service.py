@@ -17,7 +17,9 @@ from typing import Dict, Hashable, Optional
 
 import grpc
 
+from distributed_sgd_tpu import trace as trace_mod
 from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.trace import flight
 
 
 class CircuitBreaker:
@@ -96,6 +98,9 @@ class CircuitBreaker:
         self._probe_inflight = False
         if self._metrics is not None:
             self._metrics.counter("rpc.breaker.open").increment()
+        # post-mortem evidence: breaker trips are exactly the kind of
+        # cascade precursor a dead run's flight dump must contain
+        flight.record("breaker.open", peer=self._name)
 
 
 class RpcPolicy:
@@ -237,10 +242,33 @@ _SERVE_METHODS = {
 }
 
 
-def _add_servicer(server, servicer, service_name: str, methods: dict) -> None:
+def _traced_handler(fn, method: str, node: Optional[str]):
+    """Server-side trace hook (docs/OBSERVABILITY.md): when the inbound
+    call carries a TraceContext in its invocation metadata (the client
+    side only injects for sampled traces), run the method body inside a
+    server span that is a child of the caller's span — installed as the
+    thread's current context, so worker-side measure.span()s become
+    grandchildren automatically.  With tracing off (or an untraced call)
+    this is one global read + one metadata scan, no allocation."""
+
+    def handler(request, context):
+        t = trace_mod._TRACER
+        if t is None:
+            return fn(request, context)
+        ctx = trace_mod.extract(context.invocation_metadata())
+        if ctx is None:
+            return fn(request, context)
+        with t.child_span(method, ctx, node=node):
+            return fn(request, context)
+
+    return handler
+
+
+def _add_servicer(server, servicer, service_name: str, methods: dict,
+                  node: Optional[str] = None) -> None:
     handlers = {}
     for name, (req, resp) in methods.items():
-        fn = getattr(servicer, name)
+        fn = _traced_handler(getattr(servicer, name), name, node)
         handlers[name] = grpc.unary_unary_rpc_method_handler(
             fn, request_deserializer=req.FromString, response_serializer=resp.SerializeToString
         )
@@ -249,28 +277,108 @@ def _add_servicer(server, servicer, service_name: str, methods: dict) -> None:
     )
 
 
-def add_master_servicer(server, servicer) -> None:
-    _add_servicer(server, servicer, "dsgd.Master", _MASTER_METHODS)
+def add_master_servicer(server, servicer, node: Optional[str] = None) -> None:
+    _add_servicer(server, servicer, "dsgd.Master", _MASTER_METHODS, node=node)
 
 
-def add_worker_servicer(server, servicer) -> None:
-    _add_servicer(server, servicer, "dsgd.Worker", _WORKER_METHODS)
+def add_worker_servicer(server, servicer, node: Optional[str] = None) -> None:
+    _add_servicer(server, servicer, "dsgd.Worker", _WORKER_METHODS, node=node)
 
 
-def add_serve_servicer(server, servicer) -> None:
-    _add_servicer(server, servicer, "dsgd.Serving", _SERVE_METHODS)
+def add_serve_servicer(server, servicer, node: Optional[str] = None) -> None:
+    _add_servicer(server, servicer, "dsgd.Serving", _SERVE_METHODS, node=node)
+
+
+class _TracingCallable:
+    """Client-side trace hook around one unary-unary multicallable.
+
+    When the calling thread is inside a sampled trace (a master fan-out
+    window, a serving request, ...), each RPC through this callable gets
+    its own client span — hedges and retries included, each a sibling
+    child of the SAME parent span — and the context rides the gRPC
+    invocation metadata (trace.METADATA_KEY), leaving the proto wire
+    byte-identical.  Outside a trace (or with tracing off) the call
+    passes straight through: one module-global read, zero allocation
+    (tests/test_trace.py asserts the fast path never constructs a Span).
+    """
+
+    __slots__ = ("_inner", "_method", "_peer")
+
+    def __init__(self, inner, method: str, peer: Optional[str]):
+        self._inner = inner
+        self._method = method
+        self._peer = peer
+
+    def _span(self, tracer, ctx):
+        return tracer.child_span(f"rpc.{self._method}", ctx, peer=self._peer)
+
+    @staticmethod
+    def _inject(kwargs, span):
+        md = tuple(kwargs.get("metadata") or ()) + trace_mod.inject(span.ctx)
+        kwargs["metadata"] = md
+        return kwargs
+
+    @staticmethod
+    def _end_from_future(span, fut) -> None:
+        try:
+            if fut.cancelled():
+                span.end(error="cancelled")
+                return
+            exc = fut.exception()
+        except Exception as e:  # noqa: BLE001 - unreadable future = failed
+            span.end(error=repr(e))
+            return
+        span.end(error=str(exc) if exc is not None else None)
+
+    def __call__(self, request, timeout=None, **kwargs):
+        t = trace_mod._TRACER
+        ctx = trace_mod.current() if t is not None else None
+        if ctx is None:
+            return self._inner(request, timeout=timeout, **kwargs)
+        span = self._span(t, ctx)
+        try:
+            reply = self._inner(request, timeout=timeout,
+                                **self._inject(kwargs, span))
+            span.end()
+            return reply
+        except Exception as e:
+            span.end(error=repr(e))
+            raise
+
+    def future(self, request, timeout=None, **kwargs):
+        t = trace_mod._TRACER
+        ctx = trace_mod.current() if t is not None else None
+        if ctx is None:
+            return self._inner.future(request, timeout=timeout, **kwargs)
+        span = self._span(t, ctx)
+        try:
+            fut = self._inner.future(request, timeout=timeout,
+                                     **self._inject(kwargs, span))
+        except Exception as e:  # ValueError: channel closed under us
+            span.end(error=repr(e))
+            raise
+        fut.add_done_callback(lambda f: self._end_from_future(span, f))
+        return fut
 
 
 class _Stub:
     def __init__(self, channel, service_name: str, methods: dict):
+        # channel factories stamp their endpoint on the channel
+        # (new_channel below) so client spans can name their peer
+        target = getattr(channel, "dsgd_target", None)
+        peer = f"{target[0]}:{target[1]}" if target else None
         for name, (req, resp) in methods.items():
             setattr(
                 self,
                 name,
-                channel.unary_unary(
-                    f"/{service_name}/{name}",
-                    request_serializer=req.SerializeToString,
-                    response_deserializer=resp.FromString,
+                _TracingCallable(
+                    channel.unary_unary(
+                        f"/{service_name}/{name}",
+                        request_serializer=req.SerializeToString,
+                        response_deserializer=resp.FromString,
+                    ),
+                    name,
+                    peer,
                 ),
             )
 
@@ -413,6 +521,9 @@ def new_channel(host: str, port: int, origin=None) -> grpc.Channel:
         options=[("grpc.max_receive_message_length", 64 * 1024 * 1024),
                  ("grpc.max_send_message_length", 64 * 1024 * 1024)],
     )
+    # endpoint label for client trace spans (read back through the chaos
+    # proxy's __getattr__ when a plan wraps the channel)
+    channel.dsgd_target = (host, int(port))
     from distributed_sgd_tpu import chaos
 
     return chaos.wrap_channel(channel, target=(host, int(port)), origin=origin)

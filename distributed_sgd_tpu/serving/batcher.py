@@ -32,6 +32,9 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import measure
+
 log = logging.getLogger("dsgd.serving")
 
 
@@ -156,6 +159,18 @@ class MicroBatcher:
             return [self._queue.popleft() for _ in range(n)]
 
     def _loop(self) -> None:
+        # the batcher thread is serving's only executor: an uncaught
+        # exception here (collect-path bug, not a batch failure) would
+        # wedge every future Predict — leave post-mortem evidence first
+        try:
+            self._loop_impl()
+        except Exception as e:  # noqa: BLE001 - record, dump, then surface
+            flight.record("serve.batcher.crash", error=repr(e))
+            flight.dump("exception")
+            log.exception("serving batcher loop crashed")
+            raise
+
+    def _loop_impl(self) -> None:
         while True:
             batch = self._collect()
             if not batch:
@@ -166,7 +181,11 @@ class MicroBatcher:
             if self._metrics is not None:
                 self._metrics.histogram("serve.batch.size").record(len(batch))
             try:
-                results = self._run_batch(batch)
+                # one local trace per flushed batch (head-sampled): the
+                # device-execute half of a Predict's wall clock
+                with measure.span("serve.batch.execute",
+                                  metrics=self._metrics, rows=len(batch)):
+                    results = self._run_batch(batch)
                 for pending, result in zip(batch, results):
                     pending.set_result(result)
             except Exception as e:  # noqa: BLE001 - one bad batch must not kill serving

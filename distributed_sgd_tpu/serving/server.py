@@ -38,6 +38,7 @@ from distributed_sgd_tpu.rpc.service import add_serve_servicer, new_server
 from distributed_sgd_tpu.serving.batcher import MicroBatcher, PendingRequest, QueueFull
 from distributed_sgd_tpu.serving.bucketing import pack_rows
 from distributed_sgd_tpu.serving.model_store import ModelStore
+from distributed_sgd_tpu.utils import measure
 
 log = logging.getLogger("dsgd.serving")
 
@@ -59,13 +60,22 @@ class PredictEngine:
     (tests/test_serving.py asserts it).
     """
 
-    def __init__(self, model_name: str = "hinge", lam: float = 1e-5, metrics=None):
+    PROFILE_BATCHES = 8  # jax.profiler capture length (DSGD_PROFILE_DIR)
+
+    def __init__(self, model_name: str = "hinge", lam: float = 1e-5,
+                 metrics=None, profile_dir: Optional[str] = None):
         self._model_name = model_name
         self._lam = float(lam)
         self._metrics = metrics
         self._model = None
         self._jit = jax.jit(self._forward)
         self._compiled_buckets = set()
+        # DSGD_PROFILE_DIR on the serve role: capture the FIRST
+        # PROFILE_BATCHES Predict batches — the device-side view of the
+        # serving forward pass (docs/OBSERVABILITY.md).  Shared windowed
+        # capture helper with the RPC worker (utils/measure.py).
+        self._profile = measure.ProfileWindow(
+            profile_dir, self.PROFILE_BATCHES, logger=log, what="predict batches")
 
     def _forward(self, w, indices, values):
         margins = matvec(SparseBatch(indices, values), w)
@@ -88,6 +98,7 @@ class PredictEngine:
         feature dimension in between must not silently clamp indices)."""
         if snapshot is None:
             raise ModelUnavailable("no checkpoint loaded yet")
+        self._profile.tick()
         step, w = snapshot
         n_features = int(w.shape[0])
         self._ensure_model(n_features)
@@ -132,8 +143,13 @@ class ServingServicer:
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           "no model snapshot loaded yet")
         n_features = int(snap[1].shape[0])
-        idx = np.fromiter(request.indices, dtype=np.int32)
-        val = np.fromiter(request.values, dtype=np.float32)
+        # queue-wait vs decode attribution (docs/OBSERVABILITY.md): under
+        # an active trace these nest inside the Predict server span
+        # (root=False: untraced external calls must not root fragments)
+        with measure.span("serve.predict.decode", metrics=self._metrics,
+                          root=False):
+            idx = np.fromiter(request.indices, dtype=np.int32)
+            val = np.fromiter(request.values, dtype=np.float32)
         if idx.size != val.size:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           f"indices ({idx.size}) and values ({val.size}) "
@@ -148,7 +164,9 @@ class ServingServicer:
             # the backpressure contract: bounded queue, shed at the edge
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         try:
-            result = pending.wait(self._timeout)
+            with measure.span("serve.predict.queue", metrics=self._metrics,
+                              root=False):
+                result = pending.wait(self._timeout)
         except ModelUnavailable as e:
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         except TimeoutError as e:
@@ -192,6 +210,7 @@ class ServingServer:
         ckpt_poll_s: float = 2.0,
         metrics=None,
         request_timeout_s: float = 30.0,
+        profile_dir: Optional[str] = None,
     ):
         if metrics is None:
             from distributed_sgd_tpu.utils import metrics as metrics_mod
@@ -199,7 +218,8 @@ class ServingServer:
             metrics = metrics_mod.global_metrics()
         self.metrics = metrics
         self.store = ModelStore(checkpoint_dir, poll_s=ckpt_poll_s, metrics=metrics)
-        self.engine = PredictEngine(model, lam, metrics=metrics)
+        self.engine = PredictEngine(model, lam, metrics=metrics,
+                                    profile_dir=profile_dir)
         self.batcher = MicroBatcher(
             lambda rows: self.engine.run(self.store.get(), rows),
             max_batch=max_batch, max_delay_ms=max_delay_ms,
@@ -208,7 +228,8 @@ class ServingServer:
         self._server = new_server(port, host=host)
         add_serve_servicer(self._server, ServingServicer(
             self.store, self.batcher, metrics=metrics,
-            request_timeout_s=request_timeout_s))
+            request_timeout_s=request_timeout_s),
+            node=f"serve:{self._server.bound_port}")
 
     @classmethod
     def from_config(cls, cfg, metrics=None) -> "ServingServer":
@@ -222,6 +243,7 @@ class ServingServer:
             max_delay_ms=cfg.serve_max_delay_ms,
             queue_depth=cfg.serve_queue_depth,
             ckpt_poll_s=cfg.serve_ckpt_poll_s, metrics=metrics,
+            profile_dir=cfg.profile_dir,
         )
 
     @property
@@ -242,6 +264,9 @@ class ServingServer:
         self._server.stop(grace).wait()
         self.batcher.stop()
         self.store.stop()
+        # a replica that served fewer batches than the capture window must
+        # still close its jax.profiler trace on the way out
+        self.engine._profile.close()
 
     def __enter__(self) -> "ServingServer":
         return self.start()

@@ -2,22 +2,139 @@
 
 TPU-native equivalent of the reference's ``Measure`` helpers
 (utils/Measure.scala:11-35): `duration` returns (result, seconds),
-`duration_log` logs a named span, and `span` is a context manager that also
-feeds the metrics registry so spans show up in exporters.  For device work,
-callers must account for JAX async dispatch themselves (block_until_ready)
-— the trainer does this at epoch boundaries.
+`duration_log` logs a named span, and `span` is a context manager that
+feeds the metrics registry — and, when the distributed tracer is active
+(trace/, DSGD_TRACE), ALSO opens a trace span, so one instrumentation
+point serves both the aggregate surface (histograms -> exporters) and the
+causal one (span timelines -> Perfetto).  For device work, callers must
+account for JAX async dispatch themselves (block_until_ready) — the
+trainer does this at epoch boundaries.
+
+Histogram-name cardinality is bounded: span names outside
+`SPAN_NAME_ALLOWLIST` warn once each, and once `MAX_DISTINCT_SPAN_NAMES`
+distinct names have been recorded, further unknown names aggregate under
+``span.other`` — a caller that interpolates ids into span names must not
+grow the exporter payload without bound.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
 from typing import Callable, Tuple, TypeVar
+
+from distributed_sgd_tpu import trace as trace_mod
 
 T = TypeVar("T")
 
 log = logging.getLogger("dsgd.measure")
+
+# Known span names (docs/OBSERVABILITY.md); additions belong here so the
+# instrument-name consistency test (tests/test_observability.py) and the
+# dashboards agree on spelling.
+SPAN_NAME_ALLOWLIST = frozenset({
+    "slave.grad.compute",
+    "slave.grad.encode",
+    "slave.async.gossip",
+    "serve.predict.decode",
+    "serve.predict.queue",
+    "serve.batch.execute",
+    "ckpt.save",
+    "ckpt.restore",
+    "trainer.epoch",
+})
+MAX_DISTINCT_SPAN_NAMES = 64
+SPAN_OVERFLOW_NAME = "other"
+
+_seen_names: set = set()
+_warned_names: set = set()
+_names_lock = threading.Lock()
+
+
+def _bounded_name(name: str) -> str:
+    """Cardinality guard for the `span.<name>` histogram family."""
+    # lock-free fast path: after warm-up every hot-path span name is
+    # already a member, and a GIL-atomic set read needs no lock (a racing
+    # first-add just falls through to the locked slow path)
+    if name in _seen_names:
+        return name
+    with _names_lock:
+        if name in _seen_names:
+            return name
+        if name not in SPAN_NAME_ALLOWLIST and name not in _warned_names:
+            if len(_warned_names) < 2 * MAX_DISTINCT_SPAN_NAMES:
+                _warned_names.add(name)
+                log.warning(
+                    "span name %r is not in SPAN_NAME_ALLOWLIST "
+                    "(utils/measure.py); dashboards will not know it, and "
+                    "unknown names beyond %d aggregate under 'span.%s'",
+                    name, MAX_DISTINCT_SPAN_NAMES, SPAN_OVERFLOW_NAME)
+        if (name not in SPAN_NAME_ALLOWLIST
+                and len(_seen_names) >= MAX_DISTINCT_SPAN_NAMES):
+            return SPAN_OVERFLOW_NAME
+        _seen_names.add(name)
+        return name
+
+
+class ProfileWindow:
+    """Windowed ``jax.profiler`` capture shared by the RPC worker and the
+    serving engine (DSGD_PROFILE_DIR, docs/OBSERVABILITY.md): `tick()` is
+    called at the START of each dispatch; the capture opens on the first
+    tick and closes on the first tick PAST the window, so all `steps`
+    dispatch bodies land inside it (stopping at the Nth tick's start
+    would capture only N-1).  `close()` finishes a still-open capture at
+    shutdown (the run never reached `steps + 1` dispatches).  Thread-safe;
+    never raises — profiling must not break the work it observes."""
+
+    def __init__(self, profile_dir, steps: int, logger=None, what: str = "dispatches"):
+        self.dir = profile_dir
+        self.left = max(1, int(steps)) if profile_dir else 0
+        self.started = False
+        self.stopped = False
+        self.what = what
+        self._lock = threading.Lock()
+        self._log = logger or log
+
+    def tick(self) -> None:
+        if self.stopped or (self.left <= 0 and not self.started):
+            return
+        with self._lock:
+            if self.stopped:
+                return
+            try:
+                import jax
+
+                if not self.started:
+                    jax.profiler.start_trace(self.dir)
+                    self.started = True
+                    self._log.info("profiling first %d %s -> %s",
+                                   self.left, self.what, self.dir)
+                elif self.left <= 0:
+                    # first dispatch past the window: the previous `steps`
+                    # bodies are complete — close the capture
+                    self.stopped = True
+                    jax.profiler.stop_trace()
+                    self._log.info("profiler trace written to %s", self.dir)
+                    return
+                self.left -= 1
+            except Exception as e:  # noqa: BLE001 - profiling is best-effort
+                self.left = 0
+                self.stopped = True
+                self._log.warning("jax.profiler capture failed: %s", e)
+
+    def close(self) -> None:
+        with self._lock:
+            if self.started and not self.stopped:
+                self.stopped = True
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                    self._log.info("profiler trace written to %s", self.dir)
+                except Exception as e:  # noqa: BLE001
+                    self._log.warning("jax.profiler stop failed: %s", e)
 
 
 def duration(fn: Callable[[], T]) -> Tuple[T, float]:
@@ -35,11 +152,22 @@ def duration_log(name: str, fn: Callable[[], T], logger=None) -> T:
 
 
 @contextlib.contextmanager
-def span(name: str, logger=None, metrics=None):
-    """Context-manager span: logs elapsed and records a histogram sample."""
+def span(name: str, logger=None, metrics=None, root: bool = True,
+         **trace_args):
+    """Context-manager span: logs elapsed, records a histogram sample, and
+    — when tracing is active — opens a trace span (child of the thread's
+    current trace context, or a new sampled root).  `trace_args` (e.g.
+    ``node="w0:4001"``) become span attributes; with tracing off they cost
+    nothing beyond the kwargs dict.  Pass ``root=False`` for helper spans
+    that only make sense INSIDE a trace (e.g. the worker's compute/encode
+    breakdown of a Gradient call): with no active context they stay no-op
+    instead of fabricating an orphan one-span trace per unsampled call
+    (the histogram sample is recorded either way)."""
     t0 = time.perf_counter()
+    tspan = trace_mod.span(name, root=root, **trace_args)  # NOOP_SPAN when off
     try:
-        yield
+        with tspan:
+            yield tspan
     finally:
         secs = time.perf_counter() - t0
         (logger or log).debug("%s (%.3fs)", name, secs)
@@ -47,4 +175,4 @@ def span(name: str, logger=None, metrics=None):
             from distributed_sgd_tpu.utils.metrics import global_metrics
 
             metrics = global_metrics()
-        metrics.histogram(f"span.{name}").record(secs)
+        metrics.histogram(f"span.{_bounded_name(name)}").record(secs)

@@ -127,6 +127,27 @@ class Timer:
         return False
 
 
+def _influx_escape(s: str) -> str:
+    """Escape a line-protocol tag key/value: per the InfluxDB spec, commas,
+    equals signs, and spaces must be backslash-escaped in tag keys and
+    values — emitted raw they terminate the tag set early and corrupt the
+    WHOLE write batch, not just one line."""
+    return (str(s).replace("\\", "\\\\").replace(",", "\\,")
+            .replace("=", "\\=").replace(" ", "\\ "))
+
+
+def _influx_escape_measurement(s: str) -> str:
+    """Measurement names escape commas and spaces (but not '=')."""
+    return str(s).replace(",", "\\,").replace(" ", "\\ ")
+
+
+def _prom_escape(s: str) -> str:
+    """Escape a Prometheus label VALUE (exposition format): backslash,
+    double quote, and newline."""
+    return (str(s).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 class Metrics:
     """Thread-safe named-instrument registry."""
 
@@ -150,7 +171,8 @@ class Metrics:
     # -- exporters ---------------------------------------------------------
 
     def prometheus_text(self) -> str:
-        tags = ",".join(f'{k}="{v}"' for k, v in sorted(self.tags.items()))
+        tags = ",".join(f'{k}="{_prom_escape(v)}"'
+                        for k, v in sorted(self.tags.items()))
         tagstr = "{" + tags + "}" if tags else ""
 
         def mangle(name: str) -> str:
@@ -158,8 +180,14 @@ class Metrics:
 
         lines: List[str] = []
         for c in list(self._counters.values()):
-            lines.append(f"# TYPE {mangle(c.name)} counter")
-            lines.append(f"{mangle(c.name)}{tagstr} {c.value}")
+            base = mangle(c.name)
+            # conventional counter spelling: the `_total` family is the
+            # one dashboards should target; the bare-name family is kept
+            # as a parallel family for one release (docs/MIGRATION.md)
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total{tagstr} {c.value}")
+            lines.append(f"# TYPE {base} counter")
+            lines.append(f"{base}{tagstr} {c.value}")
         for h in list(self._hists.values()):
             base = mangle(h.name)
             lines.append(f"# TYPE {base} summary")
@@ -183,17 +211,21 @@ class Metrics:
     def influx_lines(self, ts_ns: Optional[int] = None) -> str:
         """InfluxDB line protocol, the reference's push format."""
         ts = ts_ns if ts_ns is not None else time.time_ns()
-        tags = "".join(f",{k}={v}" for k, v in sorted(self.tags.items()))
+        tags = "".join(f",{_influx_escape(k)}={_influx_escape(v)}"
+                       for k, v in sorted(self.tags.items()))
         lines = []
         for c in list(self._counters.values()):
-            lines.append(f"{c.name}{tags} value={c.value}i {ts}")
+            lines.append(
+                f"{_influx_escape_measurement(c.name)}{tags} "
+                f"value={c.value}i {ts}")
         for h in list(self._hists.values()):
             if h.count:
                 qs = h.quantiles()
                 qfields = ",".join(
                     f"p{int(q * 100)}={est}" for q, est in qs.items())
                 lines.append(
-                    f"{h.name}{tags} count={h.count}i,sum={h.sum},"
+                    f"{_influx_escape_measurement(h.name)}{tags} "
+                    f"count={h.count}i,sum={h.sum},"
                     f"min={h.min},max={h.max},mean={h.mean},{qfields} {ts}"
                 )
         return "\n".join(lines) + ("\n" if lines else "")
@@ -296,6 +328,17 @@ class PrometheusExporter:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                # route properly: the metrics body answers /metrics ONLY
+                # (scrapers probing / or /favicon.ico must not get — and
+                # cache — a copy of the whole exposition)
+                if self.path.split("?", 1)[0] != "/metrics":
+                    body = b"not found; metrics are at /metrics\n"
+                    self.send_response(404)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 body = registry.prometheus_text().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")

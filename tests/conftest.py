@@ -19,3 +19,13 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Flight-recorder dumps (trace/flight.py) default to the process CWD —
+# the black-box location a production crash should use — but under
+# pytest that is the repo root: redirect the session default to a temp
+# dir so eviction/crash tests don't litter the working tree.
+import tempfile  # noqa: E402
+
+from distributed_sgd_tpu.trace import flight as _flight  # noqa: E402
+
+_flight.DEFAULT_DIR = tempfile.mkdtemp(prefix="dsgd-test-flight-")

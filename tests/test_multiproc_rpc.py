@@ -18,6 +18,7 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 
 import pytest
@@ -46,6 +47,9 @@ def _env(host_port: int, master_port: int, extra=None) -> dict:
     env.update({
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
         "JAX_PLATFORMS": "cpu",
+        # subprocess flight-recorder dumps (evictions are the POINT of
+        # these tests) go to a temp dir, not the inherited repo cwd
+        "DSGD_TRACE_DIR": tempfile.mkdtemp(prefix="dsgd-mp-flight-"),
         "DSGD_SYNTHETIC": "300",
         "DSGD_NODE_HOST": "127.0.0.1",
         "DSGD_NODE_PORT": str(host_port),

@@ -1,0 +1,305 @@
+"""Observability satellites (docs/OBSERVABILITY.md): exporter escaping
+round-trips, /metrics routing + `_total` counter families, measure.span
+unification with the tracer and its cardinality bound, DSGD_PROFILE_DIR
+on the RPC worker and serve roles, and the instrument-name consistency
+gate (every constant exported by utils/metrics.py and trace/ must be
+recorded somewhere in the package — dashboards, benches, and tests can't
+drift from the spelling)."""
+
+import logging
+import os
+import re
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu import trace as trace_mod
+from distributed_sgd_tpu.utils import measure
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+from distributed_sgd_tpu.utils.metrics import Metrics, PrometheusExporter
+
+PKG_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "distributed_sgd_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off():
+    trace_mod.configure(enabled=False)
+    yield
+    trace_mod.configure(enabled=False)
+
+
+# -- InfluxDB line-protocol escaping -----------------------------------------
+
+
+def _parse_influx_line(line: str):
+    """Minimal spec-compliant parser: returns (measurement, {tag: value})
+    honoring backslash escapes — the round-trip oracle for the escaper."""
+    out = []
+    cur = ""
+    i = 0
+    while i < len(line):
+        ch = line[i]
+        if ch == "\\" and i + 1 < len(line):
+            cur += line[i + 1]
+            i += 2
+            continue
+        if ch == "," or ch == " ":
+            out.append((cur, ch))
+            cur = ""
+            if ch == " ":
+                break
+        else:
+            cur += ch
+        i += 1
+    measurement = out[0][0]
+    tags = {}
+    for token, _sep in out[1:]:
+        k, _, v = token.partition("=")
+        tags[k] = v
+    return measurement, tags
+
+
+def test_influx_tag_escaping_round_trips():
+    """metrics.influx_lines (the satellite at utils/metrics.py:186): tag
+    values with spaces, commas, and '=' must escape per the line-protocol
+    spec — raw they corrupt the whole batch."""
+    nasty = {"role": "dev worker", "node": "a,b=c", "path": "x\\y"}
+    m = Metrics(tags=nasty)
+    m.counter("master.sync.rounds").increment(3)
+    line = m.influx_lines(ts_ns=7).splitlines()[0]
+    assert " value=3i 7" in line
+    measurement, tags = _parse_influx_line(line)
+    assert measurement == "master.sync.rounds"
+    assert tags == nasty  # escaped on the wire, identical after unescape
+    # no RAW separator survives inside the tag set
+    tagset = line.split(" value=")[0]
+    assert "dev worker" not in tagset and "a,b=c" not in tagset
+
+
+def test_influx_measurement_escaping():
+    m = Metrics()
+    m.counter("weird name,x").increment()
+    line = m.influx_lines(ts_ns=1).splitlines()[0]
+    assert line.startswith("weird\\ name\\,x ")
+    measurement, _ = _parse_influx_line(line)
+    assert measurement == "weird name,x"
+
+
+# -- Prometheus exposition ----------------------------------------------------
+
+
+def test_prometheus_label_value_escaping():
+    m = Metrics(tags={"node": 'a"b\\c\nnext'})
+    m.counter("c.x").increment()
+    text = m.prometheus_text()
+    assert 'node="a\\"b\\\\c\\nnext"' in text
+    assert "\nnext" not in text.split("node=")[1].splitlines()[0]
+
+
+def test_prometheus_counters_emit_total_and_legacy_families():
+    """Counters gain the conventional `_total` suffix; the bare name stays
+    as a parallel family for one release (docs/MIGRATION.md)."""
+    m = Metrics()
+    m.counter("master.sync.rounds").increment(5)
+    text = m.prometheus_text()
+    assert "# TYPE master_sync_rounds_total counter" in text
+    assert "master_sync_rounds_total 5" in text
+    assert "# TYPE master_sync_rounds counter" in text
+    assert "\nmaster_sync_rounds 5" in text
+
+
+def test_prometheus_exporter_routes_metrics_path_only():
+    m = Metrics()
+    m.counter("serve.rejected").increment()
+    exporter = PrometheusExporter(m, port=0, host="127.0.0.1").start()
+    try:
+        base = f"http://127.0.0.1:{exporter.port}"
+        body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+        assert "serve_rejected_total" in body
+        body_q = urllib.request.urlopen(f"{base}/metrics?x=1").read().decode()
+        assert "serve_rejected_total" in body_q
+        for path in ("/", "/favicon.ico", "/metricsX"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(base + path)
+            assert ei.value.code == 404
+    finally:
+        exporter.stop()
+
+
+# -- measure.span unification + cardinality bound -----------------------------
+
+
+def test_measure_span_becomes_trace_span_when_active(tmp_path):
+    tracer = trace_mod.configure(enabled=True, dir=str(tmp_path),
+                                 sample=1.0, service="t")
+    m = Metrics()
+    with measure.span("ckpt.save", metrics=m, step=3):
+        pass
+    assert m.histogram("span.ckpt.save").count == 1  # histogram feed kept
+    spans = [e for e in tracer.events() if e.get("name") == "ckpt.save"]
+    assert len(spans) == 1 and spans[0]["args"]["step"] == 3
+
+
+def test_measure_span_histogram_only_when_tracing_off():
+    m = Metrics()
+    with measure.span("ckpt.restore", metrics=m):
+        pass
+    assert m.histogram("span.ckpt.restore").count == 1
+
+
+def test_span_name_allowlist_warning_and_overflow(monkeypatch, caplog):
+    monkeypatch.setattr(measure, "_seen_names", set())
+    monkeypatch.setattr(measure, "_warned_names", set())
+    m = Metrics()
+    with caplog.at_level(logging.WARNING, logger="dsgd.measure"):
+        with measure.span("made.up.name", metrics=m):
+            pass
+        with measure.span("made.up.name", metrics=m):
+            pass
+    warnings = [r for r in caplog.records if "made.up.name" in r.message]
+    assert len(warnings) == 1  # warned once, not per call
+    assert m.histogram("span.made.up.name").count == 2
+    # allowlisted names never warn
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="dsgd.measure"):
+        with measure.span("ckpt.save", metrics=m):
+            pass
+    assert not [r for r in caplog.records if "ckpt.save" in r.message]
+    # beyond the cap, unknown names aggregate under span.other — the
+    # exporter payload stays bounded even with interpolated names
+    for i in range(measure.MAX_DISTINCT_SPAN_NAMES + 10):
+        with measure.span(f"leaky.{i}", metrics=m):
+            pass
+    assert m.histogram("span.other").count >= 10
+    distinct = len(m._hists)
+    assert distinct <= measure.MAX_DISTINCT_SPAN_NAMES + 5
+    # allowlisted names still record under their own name past the cap
+    with measure.span("trainer.epoch", metrics=m):
+        pass
+    assert m.histogram("span.trainer.epoch").count == 1
+
+
+# -- DSGD_PROFILE_DIR on the rpc worker + serve roles -------------------------
+
+
+def _capture_files(d):
+    return [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+
+
+def test_worker_role_profiles_first_dispatches(tmp_path):
+    """The satellite: DSGD_PROFILE_DIR used to profile only the in-process
+    trainer (core/trainer.py); the RPC worker now captures its first N
+    device dispatches."""
+    from distributed_sgd_tpu.core.worker import WorkerNode
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+    from distributed_sgd_tpu.data.synthetic import rcv1_like
+    from distributed_sgd_tpu.models.linear import make_model
+
+    train, _ = train_test_split(
+        rcv1_like(64, n_features=32, nnz=4, seed=3, idf_values=True))
+    model = make_model("hinge", 1e-5, train.n_features)
+    w = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, train, model,
+                   profile_dir=str(tmp_path), profile_steps=2)
+    try:
+        ids = np.arange(8)
+        w0 = np.zeros(train.n_features, dtype=np.float32)
+        w.compute_gradient(w0, ids)
+        assert w._profile.started and w._profile.left == 1
+        w.compute_gradient(w0, ids)
+        assert w._profile.left == 0  # window consumed, capture still open
+        # dispatch N+1 is the first one PAST the window: it closes the
+        # capture, so all N bodies landed inside it
+        w.compute_gradient(w0, ids)
+        assert w._profile.stopped
+    finally:
+        w.stop()
+    assert _capture_files(str(tmp_path)), "no jax.profiler capture written"
+
+
+def test_serve_role_profiles_first_batches(tmp_path):
+    from distributed_sgd_tpu.serving.batcher import PendingRequest
+    from distributed_sgd_tpu.serving.server import PredictEngine
+
+    eng = PredictEngine("hinge", metrics=None, profile_dir=str(tmp_path))
+    eng._profile.left = 2  # shrink the capture for the test
+    snap = (7, jnp.zeros(16, dtype=jnp.float32))
+    rows = [PendingRequest(np.array([0, 3]), np.array([0.5, 0.5]))]
+    eng.run(snap, rows)
+    assert eng._profile.started and eng._profile.left == 1
+    out = eng.run(snap, rows)
+    assert eng._profile.left == 0
+    assert out[0][2] == 7  # predictions still flow while profiling
+    eng._profile.close()  # ServingServer.stop() does this in production
+    assert eng._profile.stopped
+    assert _capture_files(str(tmp_path)), "no jax.profiler capture written"
+
+
+def test_serving_server_from_config_passes_profile_dir(tmp_path):
+    from distributed_sgd_tpu.config import Config
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    cfg = Config(role_override="serve", checkpoint_dir=str(tmp_path / "ck"),
+                 profile_dir=str(tmp_path / "prof"), serve_port=0)
+    server = ServingServer.from_config(cfg)
+    assert server.engine._profile.dir == str(tmp_path / "prof")
+
+
+# -- instrument-name consistency gate -----------------------------------------
+
+
+def _package_sources():
+    out = {}
+    for root, _dirs, files in os.walk(PKG_ROOT):
+        if "__pycache__" in root:
+            continue
+        for f in files:
+            if f.endswith(".py"):
+                p = os.path.join(root, f)
+                with open(p) as fh:
+                    out[p] = fh.read()
+    return out
+
+
+def _constant_is_recorded(symbol: str, value: str, sources) -> bool:
+    """A constant counts as recorded when (a) its SYMBOL is referenced
+    beyond its definition, (b) its literal value appears at a second
+    site, or (c) an f-string constructs its family (prefix + '{')."""
+    sym_re = re.compile(rf"\b{re.escape(symbol)}\b")
+    if sum(len(sym_re.findall(src)) for src in sources.values()) >= 2:
+        return True
+    lit_re = re.compile(rf"[\"']{re.escape(value)}[\"']")
+    if sum(len(lit_re.findall(src)) for src in sources.values()) >= 2:
+        return True
+    prefix = value.rsplit(".", 1)[0] + ".{"
+    return any(prefix in src for src in sources.values())
+
+
+def test_every_instrument_constant_is_recorded_somewhere():
+    sources = _package_sources()
+    missing = []
+    for mod in (metrics_mod, trace_mod):
+        for name, value in vars(mod).items():
+            if (name.isupper() and not name.startswith("_")
+                    and isinstance(value, str) and "." in value):
+                if not _constant_is_recorded(name, value, sources):
+                    missing.append(f"{mod.__name__}.{name} = {value!r}")
+    assert not missing, (
+        "instrument-name constants exported but never recorded in the "
+        "package (spelling drift): " + ", ".join(missing))
+
+
+def test_every_allowlisted_span_name_is_used():
+    sources = _package_sources()
+    missing = [
+        name for name in measure.SPAN_NAME_ALLOWLIST
+        if not any(f'"{name}"' in src or f"'{name}'" in src
+                   for p, src in sources.items()
+                   if not p.endswith(os.path.join("utils", "measure.py")))
+    ]
+    assert not missing, (
+        "SPAN_NAME_ALLOWLIST entries never opened as spans anywhere: "
+        + ", ".join(missing))

@@ -1,0 +1,411 @@
+"""Distributed tracing + flight recorder (trace/; docs/OBSERVABILITY.md).
+
+Correctness story under test: with tracing off nothing changes — the
+public surface returns one shared no-op singleton and never allocates a
+Span (asserted by poisoning Span.__init__), the wire carries no metadata,
+and the flight recorder still collects evidence and dumps on SIGUSR2 /
+eviction.  With tracing on, a TraceContext crosses a REAL loopback gRPC
+channel via invocation metadata (retries and hedges re-use the parent
+span), head sampling is a deterministic function of the trace_id, and a
+DevCluster chaos+quorum fit yields per-process files that trace.merge
+collates into one valid Chrome trace where the injected delay, a hedge,
+and a quorum-degraded window are attributed events.
+"""
+
+import json
+import os
+import signal
+import time
+
+import pytest
+
+from distributed_sgd_tpu import trace as trace_mod
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import (
+    WorkerStub,
+    add_worker_servicer,
+    new_channel,
+    new_server,
+)
+from distributed_sgd_tpu.trace import flight, merge
+
+
+@pytest.fixture(autouse=True)
+def _clean_observability():
+    """Every test starts and ends with tracing off and a fresh default
+    flight recorder — leaked state would silently trace other tests."""
+    trace_mod.configure(enabled=False)
+    flight.configure(capacity=flight.DEFAULT_CAPACITY)
+    yield
+    trace_mod.configure(enabled=False)
+    flight.configure(capacity=flight.DEFAULT_CAPACITY)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(
+        rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=31,
+                  idf_values=True))
+
+
+@pytest.fixture(scope="module")
+def model_fn(data):
+    train, _ = data
+    ds = dim_sparsity(train)
+    return lambda: make_model("hinge", 1e-5, train.n_features,
+                              dim_sparsity=ds)
+
+
+def _ack(self, request, context):
+    return pb.Ack()
+
+
+class _PingServicer:
+    """Worker-servicer shape whose Ping records the trace context the
+    server-side hook installed (None for untraced calls)."""
+
+    RegisterSlave = UnregisterSlave = Forward = Gradient = _ack
+    StartAsync = StopAsync = UpdateGrad = _ack
+
+    def __init__(self):
+        self.seen = []
+
+    def Ping(self, request, context):  # noqa: N802
+        self.seen.append((trace_mod.current(), trace_mod.current_node()))
+        return pb.Ack()
+
+
+@pytest.fixture()
+def loopback():
+    sv = _PingServicer()
+    server = new_server(0, host="127.0.0.1")
+    add_worker_servicer(server, sv, node="w-test")
+    server.start()
+    ch = new_channel("127.0.0.1", server.bound_port)
+    stub = WorkerStub(ch)
+    yield sv, stub
+    ch.close()
+    server.stop(0)
+
+
+# -- zero-cost off path -------------------------------------------------------
+
+
+def test_off_path_returns_the_noop_singleton():
+    assert trace_mod.active() is None
+    assert trace_mod.span("x") is trace_mod.NOOP_SPAN
+    assert trace_mod.root_span("y", node="n") is trace_mod.NOOP_SPAN
+    trace_mod.event("e", a=1)  # no-op, no error
+    with trace_mod.span("z") as s:
+        s.event("inner")
+        s.set(k=1)
+    assert trace_mod.current() is None
+
+
+def test_off_path_allocates_zero_span_objects(monkeypatch, loopback):
+    """The acceptance bar 'provably zero-cost no-op spans': poison the
+    Span constructor, then exercise every instrumented surface — module
+    helpers, measure.span, and a real loopback RPC through the client +
+    server hooks.  Any Span allocation raises."""
+    from distributed_sgd_tpu.utils import measure
+
+    def _boom(*a, **k):
+        raise AssertionError("Span allocated on the tracing-off path")
+
+    monkeypatch.setattr(trace_mod.Span, "__init__", _boom)
+    assert trace_mod.span("x") is trace_mod.NOOP_SPAN
+    with measure.span("slave.grad.compute"):
+        pass
+    sv, stub = loopback
+    stub.Ping(pb.Empty(), timeout=5.0)
+    stub.Ping.future(pb.Empty(), timeout=5.0).result(timeout=5.0)
+    assert sv.seen == [(None, None), (None, None)]
+
+
+def test_sampled_out_trace_allocates_zero_span_objects(monkeypatch, tmp_path):
+    trace_mod.configure(enabled=True, dir=str(tmp_path), sample=0.0,
+                        service="t")
+    monkeypatch.setattr(
+        trace_mod.Span, "__init__",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("Span allocated for a sampled-out trace")))
+    assert trace_mod.root_span("sync.window") is trace_mod.NOOP_SPAN
+    assert trace_mod.span("child") is trace_mod.NOOP_SPAN
+
+
+def test_helper_spans_do_not_root_orphan_traces(monkeypatch, tmp_path):
+    """root=False helper spans (slave.grad.*, serve.predict.*) must stay
+    no-op when no trace context is active — an unsampled round's worker
+    calls would otherwise each fabricate an orphan one-span trace,
+    breaking per-trace_id head sampling's end-to-end property."""
+    from distributed_sgd_tpu.utils import measure
+
+    tracer = trace_mod.configure(enabled=True, dir=str(tmp_path),
+                                 sample=1.0, service="t")
+    monkeypatch.setattr(
+        trace_mod.Span, "__init__",
+        lambda *a, **k: (_ for _ in ()).throw(
+            AssertionError("Span allocated for a parentless helper span")))
+    assert trace_mod.current() is None
+    assert trace_mod.span("slave.grad.compute", root=False) is trace_mod.NOOP_SPAN
+    with measure.span("slave.grad.encode", root=False):
+        pass  # histogram still fed; no trace events
+    assert tracer.events() == []
+
+
+def test_sigusr2_handler_defers_off_the_interrupted_thread(tmp_path):
+    """Regression: the SIGUSR2 handler must not dump inline — CPython runs
+    it on the main thread, so if the signal lands while the main thread is
+    itself inside dump() (holding the non-reentrant _dump_lock, e.g. a
+    below-quorum dump), an inline dump would deadlock the process."""
+    rec = flight.configure(capacity=8, service="sig2", dir=str(tmp_path))
+    rec.record("quorum.degraded", window=3)
+    assert flight.install_signal_handler()
+    path = os.path.join(str(tmp_path),
+                        f"flight-sig2-{os.getpid()}-sigusr2.json")
+    with rec._dump_lock:  # simulate an in-flight dump on this thread
+        os.kill(os.getpid(), signal.SIGUSR2)
+        time.sleep(0.2)  # handler has run; inline dumping would hang here
+        assert not os.path.exists(path)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not os.path.exists(path):
+        time.sleep(0.02)
+    with open(path) as f:
+        assert [e["kind"] for e in json.load(f)["events"]] == [
+            "quorum.degraded"]
+
+
+def test_head_sampling_is_deterministic_and_proportional(tmp_path):
+    a = trace_mod.Tracer(sample=0.5, service="a")
+    b = trace_mod.Tracer(sample=0.5, service="b")
+    ids = [f"{i:016x}" for i in range(4000)]
+    decisions = [a.sampled(t) for t in ids]
+    # every node makes the SAME decision for the same trace_id: a sampled
+    # round is traced end to end
+    assert decisions == [b.sampled(t) for t in ids]
+    frac = sum(decisions) / len(ids)
+    assert 0.4 < frac < 0.6
+
+
+# -- context propagation ------------------------------------------------------
+
+
+def test_metadata_inject_extract_roundtrip():
+    ctx = trace_mod.TraceContext("abc123", "def456", "")
+    md = trace_mod.inject(ctx)
+    assert md == ((trace_mod.METADATA_KEY, "abc123-def456"),)
+    got = trace_mod.extract(md)
+    assert got.trace_id == "abc123" and got.span_id == "def456"
+    assert trace_mod.extract(()) is None
+    assert trace_mod.extract((("other", "x"),)) is None
+    for malformed in ("garbage", "abc-", "-def", "-"):
+        assert trace_mod.extract(
+            ((trace_mod.METADATA_KEY, malformed),)) is None
+
+
+def test_loopback_propagation_and_parent_reuse(tmp_path, loopback):
+    """A real gRPC round trip carries the context in invocation metadata
+    (the proto wire untouched); a retry and a hedge (future-form call)
+    inside the same window are SIBLING client spans re-using the window
+    span as parent; each server span is a child of its client span."""
+    sv, stub = loopback
+    tracer = trace_mod.configure(enabled=True, dir=str(tmp_path),
+                                 sample=1.0, service="t")
+    with trace_mod.root_span("sync.window", node="master") as root:
+        root_ctx = root.ctx
+        stub.Ping(pb.Empty(), timeout=5.0)                       # attempt
+        stub.Ping(pb.Empty(), timeout=5.0)                       # retry
+        stub.Ping.future(pb.Empty(), timeout=5.0).result(5.0)    # hedge
+    assert len(sv.seen) == 3
+    for ctx, node in sv.seen:
+        assert ctx is not None and ctx.trace_id == root_ctx.trace_id
+        assert node == "w-test"
+    # the future-form client span closes from a gRPC callback thread
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        clients = [e for e in tracer.events() if e.get("name") == "rpc.Ping"]
+        if len(clients) == 3:
+            break
+        time.sleep(0.01)
+    assert len(clients) == 3
+    assert {e["args"]["parent_id"] for e in clients} == {root_ctx.span_id}
+    assert all(e["args"]["trace_id"] == root_ctx.trace_id for e in clients)
+    servers = [e for e in tracer.events() if e.get("name") == "Ping"]
+    assert len(servers) == 3
+    client_ids = {e["args"]["span_id"] for e in clients}
+    assert {e["args"]["parent_id"] for e in servers} <= client_ids
+
+
+# -- export + merge -----------------------------------------------------------
+
+
+def test_flush_and_merge_filters_by_trace_id(tmp_path):
+    tracer = trace_mod.configure(enabled=True, dir=str(tmp_path),
+                                 sample=1.0, service="m")
+    with trace_mod.root_span("sync.window", node="master") as s1:
+        tid1 = s1.ctx.trace_id
+        with trace_mod.span("child"):
+            trace_mod.event("ev", k=1)
+    with trace_mod.root_span("eval.forward", node="master") as s2:
+        tid2 = s2.ctx.trace_id
+    path = tracer.flush()
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        json.load(f)  # valid JSON, the openable contract
+    merged = merge.merge_dir(str(tmp_path))
+    names = [e.get("name") for e in merged["traceEvents"]]
+    assert "sync.window" in names and "child" in names and "ev" in names
+    only1 = merge.merge_dir(str(tmp_path), trace_id=tid1)
+    got = {e["args"]["trace_id"] for e in only1["traceEvents"]
+           if e.get("ph") != "M"}
+    assert got == {tid1}
+    summary = merge.list_traces(merged["traceEvents"])
+    assert set(summary) == {tid1, tid2}
+    assert summary[tid1]["spans"] == 2 and summary[tid1]["events"] == 1
+
+
+def test_merge_cli_writes_openable_file(tmp_path, capsys):
+    tracer = trace_mod.configure(enabled=True, dir=str(tmp_path),
+                                 sample=1.0, service="cli")
+    with trace_mod.root_span("sync.window"):
+        pass
+    tracer.flush()
+    out = os.path.join(str(tmp_path), "merged.json")
+    assert merge.main([str(tmp_path), "-o", out]) == 0
+    with open(out) as f:
+        data = json.load(f)
+    assert data["traceEvents"]
+    assert capsys.readouterr().out.strip() == out
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_with_monotonic_timestamps(tmp_path):
+    rec = flight.configure(capacity=4, service="t", dir=str(tmp_path))
+    for i in range(10):
+        rec.record("quorum.degraded", i=i)
+    events = rec.snapshot()
+    assert [e["i"] for e in events] == [6, 7, 8, 9]  # newest 4 survive
+    monos = [e["t_mono"] for e in events]
+    assert monos == sorted(monos)
+    path = rec.dump("manual")
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["reason"] == "manual" and len(payload["events"]) == 4
+
+
+def test_flight_capacity_zero_disables(tmp_path):
+    rec = flight.configure(capacity=0, service="t", dir=str(tmp_path))
+    rec.record("anything")
+    assert rec.snapshot() == [] and rec.dump("nope") is None
+
+
+def test_sigusr2_dumps_flight_recorder(tmp_path):
+    """The acceptance bar: SIGUSR2 dumps a JSON of recent events with
+    monotonic timestamps, TRACING DISABLED."""
+    assert trace_mod.active() is None
+    flight.configure(capacity=16, service="sig", dir=str(tmp_path))
+    flight.record("breaker.open", peer="w9")
+    flight.record("chaos.delay", method="Gradient")
+    assert flight.install_signal_handler()
+    os.kill(os.getpid(), signal.SIGUSR2)
+    deadline = time.monotonic() + 5.0
+    path = os.path.join(str(tmp_path),
+                        f"flight-sig-{os.getpid()}-sigusr2.json")
+    while time.monotonic() < deadline and not os.path.exists(path):
+        time.sleep(0.02)
+    with open(path) as f:
+        payload = json.load(f)
+    kinds = [e["kind"] for e in payload["events"]]
+    assert kinds == ["breaker.open", "chaos.delay"]
+    assert all("t_mono" in e for e in payload["events"])
+
+
+# -- end to end: chaos + quorum fit -> merged attributed timeline -------------
+
+
+def test_e2e_chaos_quorum_fit_merged_trace(tmp_path, data, model_fn):
+    """DevCluster sync fit with tracing on + a chaos plan (20 ms delays
+    everywhere, w1 partitioned all fit): trace.merge collates the
+    per-process file into one valid Chrome trace where the injected
+    delay, a hedge, and a quorum-degraded window are attributed
+    spans/events — and an eviction dumps the flight ring."""
+    train, test = data
+    trace_mod.configure(enabled=True, dir=str(tmp_path), sample=1.0,
+                        service="dev")
+    flight.configure(capacity=256, dir=str(tmp_path), service="dev")
+    with DevCluster(model_fn(), train, test, n_workers=2, seed=0,
+                    chaos="seed=5;delay=20ms;partition=w1:60s@0s") as c:
+        res = c.master.fit_sync(
+            max_epochs=1, batch_size=64, learning_rate=0.5,
+            quorum=1, straggler_soft_s=0.3, grad_timeout_s=2.0)
+        assert res.epochs_run == 1
+        # quorum-satisfied rounds never evict: the partitioned straggler
+        # is still a member when we simulate an eviction below
+        assert len(c.master._members()) == 2
+        wkey = (c.workers[1].host, c.workers[1].port)
+        c.master.unregister_worker(*wkey, evicted=True)
+    trace_mod.flush()
+
+    merged = merge.merge_dir(str(tmp_path))
+    json.loads(json.dumps(merged))  # valid, serializable trace JSON
+    events = merged["traceEvents"]
+    by_name = {}
+    for e in events:
+        by_name.setdefault(e.get("name"), []).append(e)
+    # spans across the process boundary: master windows, client RPCs,
+    # worker server + compute spans
+    for name in ("sync.window", "rpc.Gradient", "Gradient",
+                 "slave.grad.compute"):
+        assert by_name.get(name), f"no {name} span in merged trace"
+    # the injected faults are attributed events, not mystery latency
+    assert by_name.get("chaos.delay") and by_name.get("chaos.partition")
+    assert by_name["chaos.delay"][0]["args"]["method"] == "Gradient"
+    # quorum machinery is visible: hedge + degraded window
+    assert by_name.get(trace_mod.EVENT_QUORUM_HEDGE)
+    assert by_name.get(trace_mod.EVENT_QUORUM_DEGRADED)
+    # attribution: a degraded window's trace contains its window span AND
+    # injected-fault events — one collated timeline per round
+    tid = by_name[trace_mod.EVENT_QUORUM_DEGRADED][0]["args"]["trace_id"]
+    in_trace = [e for e in events if e.get("args", {}).get("trace_id") == tid]
+    assert any(e.get("name") == "sync.window" for e in in_trace)
+    assert any(str(e.get("name", "")).startswith("chaos.") for e in in_trace)
+
+    # the eviction dumped the flight ring with the fit's quorum/chaos
+    # evidence, monotonic timestamps included
+    dump_path = os.path.join(
+        str(tmp_path), f"flight-dev-{os.getpid()}-eviction.json")
+    with open(dump_path) as f:
+        payload = json.load(f)
+    kinds = {e["kind"] for e in payload["events"]}
+    assert "worker.evicted" in kinds
+    assert any(k.startswith("chaos.") for k in kinds)
+    assert any(k.startswith("quorum.") for k in kinds)
+    monos = [e["t_mono"] for e in payload["events"]]
+    assert monos == sorted(monos)
+
+
+def test_flight_records_quorum_and_chaos_with_tracing_disabled(
+        tmp_path, data, model_fn):
+    """A dead run leaves evidence WITHOUT tracing enabled: the same
+    chaos+quorum fit with the tracer off still fills the flight ring."""
+    train, test = data
+    assert trace_mod.active() is None
+    flight.configure(capacity=256, dir=str(tmp_path), service="dark")
+    with DevCluster(model_fn(), train, test, n_workers=2, seed=0,
+                    chaos="seed=5;delay=10ms;partition=w1:60s@0s") as c:
+        c.master.fit_sync(max_epochs=1, batch_size=128, learning_rate=0.5,
+                          quorum=1, straggler_soft_s=0.25, grad_timeout_s=2.0)
+    path = flight.dump("postmortem")
+    with open(path) as f:
+        payload = json.load(f)
+    kinds = {e["kind"] for e in payload["events"]}
+    assert any(k.startswith("chaos.") for k in kinds)
+    assert any(k.startswith("quorum.") for k in kinds)
+    # and no trace files were written
+    assert merge.trace_files(str(tmp_path)) == []
