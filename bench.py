@@ -326,6 +326,16 @@ def main() -> None:
 
         bench_trace.main(smoke="--smoke" in sys.argv)
         return
+    if "--elastic" in sys.argv:
+        # elastic gate (docs/ELASTICITY.md): batch-drain apply throughput
+        # (per-message vs inbox-drain on a real loopback master) + sparse
+        # gossip topology convergence parity (all vs ring vs random:2,
+        # in-process AND through the RPC plane with every elastic knob on).
+        # --smoke is the CI-sized asserting mode.
+        from benches import bench_elastic
+
+        bench_elastic.main(smoke="--smoke" in sys.argv)
+        return
     if "--chaos" in sys.argv:
         # chaos gate (docs/FAULT_TOLERANCE.md): sync training under the
         # canonical seeded fault plan, quorum on vs off — asserts

@@ -157,6 +157,34 @@ def main() -> None:
     log(f"grpc_async: {res3.state.updates} updates in {wall:.0f}s, "
         f"final smoothed {res3.test_losses[-1]:.4f} best {res3.state.loss:.4f}")
 
+    # -- sparse gossip topologies (--topologies; docs/ELASTICITY.md) -------
+    # ring and random:2 Hogwild rows on the same data/budget, with the
+    # convergence-parity verdict vs the all-to-all row above — the
+    # full-budget twin of `python bench.py --elastic`'s asserted gate
+    if "--topologies" in sys.argv:
+        base = out["hogwild"]["best_smoothed"]
+        bound = max(1.02 * base, base + 0.02)  # docs/COMPRESSION.md gate
+        out["topology_parity_bound"] = round(bound, 4)
+        for topo in ("ring", "random:2"):
+            t0 = time.perf_counter()
+            eng_t = HogwildEngine(
+                model, n_workers=N_WORKERS, batch_size=BATCH,
+                learning_rate=LR, check_every=max(1000, budget // 40),
+                leaky_loss=LEAKY, backoff_s=0.2, steps_per_dispatch=32,
+                gossip_topology=topo)
+            res_t = eng_t.fit(train, test, max_epochs=MAX_EPOCHS)
+            wall = time.perf_counter() - t0
+            best = round(float(res_t.state.loss), 4)
+            out[f"hogwild_{topo.replace(':', '_')}"] = {
+                "updates": int(res_t.state.updates),
+                "updates_per_s": round(res_t.state.updates / wall, 1),
+                "best_smoothed": best,
+                "parity_ok": int(best <= bound),
+                "wall_s": round(wall, 1),
+            }
+            log(f"hogwild[{topo}]: best smoothed {best:.4f} vs bound "
+                f"{bound:.4f} ({'OK' if best <= bound else 'FAIL'})")
+
     sync_final = out["sync"]["final"]
     out["gap_hogwild"] = round(out["hogwild"]["best_smoothed"] - sync_final, 4)
     out["gap_local_sgd"] = round(out["local_sgd"]["best_smoothed"] - sync_final, 4)

@@ -19,8 +19,11 @@ tracking (MasterAsync.scala:66-69,130-139; SURVEY.md §5.4).  Wiring
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import logging
-from typing import Any, Dict, Optional, Tuple
+import os
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -193,6 +196,120 @@ def save_sync_fit(checkpointer, epoch: int, weights, test_losses_newest_first,
                   opt_kind: str = "sgd", opt_leaves=()) -> None:
     checkpointer.save(epoch, weights, extra=sync_fit_extra(
         test_losses_newest_first, opt_kind, list(opt_leaves)))
+
+
+# -- crash-safe FULL fit state (docs/ELASTICITY.md; DSGD_FIT_CKPT_EVERY) -----
+#
+# The epoch-cadence snapshots above capture weights + optimizer state at
+# epoch boundaries; a master killed MID-epoch replays the whole epoch on
+# restart.  The fit-state snapshot captures everything the fit_sync loop
+# needs to resume BIT-EXACTLY from the last completed window: weights,
+# optimizer leaves, the epoch + window cursor, the np.random.Generator
+# bit-generator state (so the resumed run replays the identical sample
+# draws), the early-stopping history, the broadcast version, and the
+# fit_token lineage (every token that has driven this fit — a restarted
+# master issues a NEW token from its per-incarnation nonce, so long-lived
+# workers reset stale per-fit state, and the lineage records the chain).
+# Written ATOMICALLY (tmp + os.replace): a crash mid-write leaves the
+# previous snapshot intact, never a torn file.
+
+FIT_STATE_FILE = "fit_state.npz"
+
+
+def fit_state_path(directory: str) -> str:
+    """Canonical fit-state snapshot location under a checkpoint dir."""
+    return os.path.join(directory, FIT_STATE_FILE)
+
+
+@dataclasses.dataclass
+class FitState:
+    """Decoded crash-recovery snapshot of one fit_sync loop."""
+
+    epoch: int
+    batch: int                    # window cursor within `epoch`
+    weights: np.ndarray
+    rng_state: Dict[str, Any]     # np.random.Generator.bit_generator.state
+    test_losses_nf: List[float]   # newest-first early-stopping history
+    opt_leaves: List[np.ndarray]
+    bcast_version: int
+    fit_tokens: List[int]         # lineage: tokens that have driven this fit
+    # terminal marker: the CONVERGENCE CRITERION ended this fit at
+    # epoch < max_epochs — a restart must take the nothing-to-run path
+    # even though the epoch cursor says budget remains (resuming a
+    # converged fit would train PAST convergence).  Budget exhaustion is
+    # deliberately NOT marked: the epoch cursor already carries it, and
+    # an unmarked terminal snapshot lets a re-run with a raised
+    # max_epochs resume training
+    finished: bool = False
+
+
+def save_fit_state(
+    path: str,
+    *,
+    weights,
+    epoch: int,
+    batch: int,
+    rng_state: Dict[str, Any],
+    test_losses_nf,
+    opt_kind: str,
+    opt_leaves,
+    bcast_version: int = 0,
+    fit_tokens=(),
+    finished: bool = False,
+) -> None:
+    """Atomic full-fit-state snapshot (see the section comment above)."""
+    from distributed_sgd_tpu.utils.measure import span
+
+    with span("ckpt.save", step=int(epoch), batch=int(batch)):
+        state: Dict[str, Any] = {
+            "weights": np.asarray(weights, np.float32),
+            "epoch": np.int64(epoch),
+            "batch": np.int64(batch),
+            "rng_state": np.frombuffer(
+                json.dumps(rng_state).encode(), dtype=np.uint8),
+            "opt_kind": np.frombuffer(opt_kind.encode(), dtype=np.uint8),
+            "bcast_version": np.int64(bcast_version),
+            "fit_tokens": np.asarray(list(fit_tokens), dtype=np.int64),
+            "finished": np.int64(1 if finished else 0),
+        }
+        if test_losses_nf:
+            state["test_losses_nf"] = np.asarray(test_losses_nf, np.float32)
+        for i, leaf in enumerate(opt_leaves):
+            state[f"opt_{i}"] = np.asarray(leaf)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **state)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # atomic on POSIX: old snapshot or new, never torn
+
+
+def restore_fit_state(path: Optional[str], opt_kind: str,
+                      expected_leaves) -> Optional[FitState]:
+    """Load + validate a fit-state snapshot; None when absent.  Optimizer
+    kind/leaf validation reuses decode_sync_fit_state, so a snapshot from
+    a differently-configured fit refuses loudly instead of resuming with
+    misassembled state."""
+    if not path or not os.path.exists(path):
+        return None
+    from distributed_sgd_tpu.utils.measure import span
+
+    with span("ckpt.restore", step=-1):
+        with np.load(path) as z:
+            state = {k: z[k] for k in z.files}
+    test_nf, opt_leaves = decode_sync_fit_state(state, opt_kind, expected_leaves)
+    return FitState(
+        epoch=int(state["epoch"]),
+        batch=int(state["batch"]),
+        weights=np.asarray(state["weights"], np.float32),
+        rng_state=json.loads(bytes(np.asarray(state["rng_state"],
+                                              np.uint8)).decode()),
+        test_losses_nf=test_nf,
+        opt_leaves=opt_leaves,
+        bcast_version=int(state.get("bcast_version", 0)),
+        fit_tokens=[int(t) for t in state.get("fit_tokens", [])],
+        finished=bool(int(state.get("finished", 0))),
+    )
 
 
 def save_sync_fit_final(checkpointer, epochs_run: int, start_epoch: int,

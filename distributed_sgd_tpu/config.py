@@ -97,6 +97,24 @@ class Config:
     # post-mortem dumps: SIGUSR2, eviction, below-quorum, loop crash);
     # 0 disables recording entirely
     flight_recorder: int = 512
+    # -- elastic membership + crash-safe training state (docs/ELASTICITY.md)
+    # gossip topology for the async delta plane: all (reference full
+    # fan-out, byte-identical default) | ring | random:k — deterministic
+    # sparse peer selection per (dispatch, worker) with breaker-aware
+    # reselection; the master always receives every delta
+    gossip_topology: str = "all"
+    # elastic async membership: resplit + re-issue assignments on ANY
+    # membership change (join or leave) mid-StartAsync; off keeps the
+    # merge-into-survivors eviction path and mid-fit joins idle
+    elastic: bool = False
+    # batch-drain master inbox: buffer async UpdateGrads and apply one
+    # summed update per drain instead of one jitted apply per message
+    async_drain: bool = False
+    # crash-safe fit-state cadence: snapshot the FULL sync-fit loop state
+    # (weights/opt/RNG/epoch/window cursor/fit-token lineage) atomically
+    # every N successful windows into checkpoint_dir; 0 disables.  A
+    # restarted master resumes bit-exactly from the last snapshot.
+    fit_ckpt_every: int = 0
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     # InfluxDB write endpoint for the push reporter (reference parity:
     # Kamon InfluxDBReporter, application.conf:54-78), e.g.
@@ -181,6 +199,17 @@ class Config:
             from distributed_sgd_tpu.chaos import parse_plan
 
             parse_plan(self.chaos)
+        # fail topology typos at construction; grammar owned by
+        # parallel/topology.parse_topology
+        from distributed_sgd_tpu.parallel.topology import parse_topology
+
+        parse_topology(self.gossip_topology)
+        if self.fit_ckpt_every < 0:
+            raise ValueError("fit_ckpt_every must be >= 0 (0 disables)")
+        if self.fit_ckpt_every > 0 and not self.checkpoint_dir:
+            raise ValueError(
+                "DSGD_FIT_CKPT_EVERY needs DSGD_CHECKPOINT_DIR: the crash "
+                "snapshot lives under the checkpoint directory")
         if not 0.0 <= self.trace_sample <= 1.0:
             raise ValueError("trace_sample must be a probability in [0, 1]")
         if self.flight_recorder < 0:
@@ -287,6 +316,11 @@ class Config:
             trace_sample=_env("DSGD_TRACE_SAMPLE", cls.trace_sample, float),
             flight_recorder=_env("DSGD_FLIGHT_RECORDER",
                                  cls.flight_recorder, int),
+            gossip_topology=_env("DSGD_GOSSIP_TOPOLOGY",
+                                 cls.gossip_topology, str),
+            elastic=_env("DSGD_ELASTIC", cls.elastic, bool),
+            async_drain=_env("DSGD_ASYNC_DRAIN", cls.async_drain, bool),
+            fit_ckpt_every=_env("DSGD_FIT_CKPT_EVERY", cls.fit_ckpt_every, int),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             influx_url=_env("DSGD_INFLUX_URL", None, str),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),

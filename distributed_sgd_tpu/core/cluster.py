@@ -41,6 +41,8 @@ class DevCluster:
         compress_k: float = 0.01,
         compress_ef: bool = True,
         chaos: Optional[str] = None,
+        gossip_topology: str = "all",
+        master_watch_s: Optional[float] = None,
     ):
         # fault injection (chaos/, DSGD_CHAOS): the plan must be installed
         # BEFORE any node opens a channel so every stub is wrapped — but it
@@ -57,6 +59,14 @@ class DevCluster:
                               armed=False)
             self._chaos_installed = True
         devs = list(devices if devices is not None else jax.devices())
+        # kept for add_worker (elastic churn: join a fresh worker mid-fit)
+        self._host, self._devs, self._seed = host, devs, seed
+        self._train, self._model = train, model
+        self._worker_kwargs = dict(
+            steps_per_dispatch=steps_per_dispatch, compress=compress,
+            compress_k=compress_k, compress_ef=compress_ef,
+            gossip_topology=gossip_topology, master_watch_s=master_watch_s,
+        )
         self.master = MasterNode(
             host, base_port, train, test, model,
             expected_workers=n_workers, seed=seed,
@@ -75,6 +85,8 @@ class DevCluster:
                 steps_per_dispatch=steps_per_dispatch,
                 compress=compress, compress_k=compress_k,
                 compress_ef=compress_ef,
+                gossip_topology=gossip_topology,
+                master_watch_s=master_watch_s,
             )
             self.workers.append(w)
             if self._chaos_installed:
@@ -90,6 +102,30 @@ class DevCluster:
             chaos_mod.arm()
             log.warning("chaos plan armed: %s", chaos)
         log.info("dev cluster ready: master :%d + %d workers", self.master.port, n_workers)
+
+    def add_worker(self, seed: Optional[int] = None,
+                   wait_registered: bool = True) -> WorkerNode:
+        """Join a NEW worker to the running cluster (elastic churn /
+        grow-back tests, docs/ELASTICITY.md): same data + model, an
+        OS-assigned port, registered through the real control plane.  The
+        master must have a free membership slot (an eviction or graceful
+        leave frees one); an elastic fit absorbs the join at its next
+        membership tick."""
+        i = len(self.workers)
+        w = WorkerNode(
+            self._host, 0, self._host, self.master.port,
+            self._train, self._model,
+            device=self._devs[i % len(self._devs)],
+            seed=self._seed + i if seed is None else seed,
+            **self._worker_kwargs,
+        )
+        self.workers.append(w)
+        if self._chaos_installed:
+            from distributed_sgd_tpu import chaos as chaos_mod
+
+            chaos_mod.name_endpoint(self._host, w.port, f"w{i}")
+        w.start(wait_registered=wait_registered)
+        return w
 
     def stop(self) -> None:
         for w in self.workers:

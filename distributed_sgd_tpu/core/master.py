@@ -29,7 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_sgd_tpu.checkpoint import (
+    restore_fit_state,
     restore_sync_fit,
+    save_fit_state,
     save_sync_fit,
     save_sync_fit_final,
 )
@@ -397,6 +399,23 @@ class MasterNode:
         # the check loop wakes immediately instead of a full backoff later)
         self._async_done = threading.Event()
         self._apply = jax.jit(lambda w, d: w - d)
+        # batch-drain inbox (docs/ELASTICITY.md; ROADMAP item 4): with
+        # fit_async(batch_drain=True) incoming UpdateGrads buffer here and
+        # a drain thread applies ONE summed update per drain — deltas
+        # commute (parallel/hogwild.py _drain_inbox), so the per-message
+        # jitted apply under _async_lock stops being the scaling wall.
+        # Off (default) the servicer applies per message, byte-identical
+        # to the pre-drain engine.
+        self._inbox: List[Tuple[np.ndarray, int]] = []
+        self._inbox_cv = threading.Condition()
+        self._drain_on = False
+        # endpoints that RE-registered while already members (a worker
+        # process restarted on the same host:port before any eviction —
+        # the new process idles with no assignment, heartbeats succeed,
+        # and membership is unchanged, so neither the elastic resplit nor
+        # the stall watchdog would ever re-issue its slice); the async
+        # fit loop kicks these with their current assignment each tick
+        self._rereg_pending: set = set()
 
         self.server = new_server(port, host="0.0.0.0")
         self.port = self.port or self.server.bound_port
@@ -490,18 +509,48 @@ class MasterNode:
         re-split (elastic grow-back up to the configured cluster size;
         tests/test_fault_tolerance.py::test_worker_rejoins_mid_fit)."""
         key = (host, port)
+        rereg_stub = None
         with self._members_lock:
             if key in self._workers:
-                return
-            if len(self._workers) >= self.expected_workers:
+                # already a member: either a redundant registration retry
+                # (first attempt landed but its reply was lost) or a worker
+                # process RESTARTED on the same endpoint — during an async
+                # fit both are safe to answer with a fresh StartAsync kick
+                # (the worker side replaces a running loop idempotently),
+                # and the restarted-process case REQUIRES it: the idle new
+                # process passes heartbeats, so nothing else would ever
+                # re-issue its slice
+                if self._async_running.is_set():
+                    self._rereg_pending.add(key)
+                rereg_stub = self._workers[key]
+                rereg_others = [k for k in self._workers if k != key]
+            elif len(self._workers) >= self.expected_workers:
                 raise ValueError("cluster already at expected node count")
-            others = list(self._workers.keys())
-            ch = new_channel(host, port, origin=(self.host, self.port))
-            stub = WorkerStub(ch)
-            self._workers[key] = stub
-            self._channels[key] = ch
-            self._order.append(key)
-            count = len(self._workers)
+            else:
+                others = list(self._workers.keys())
+                ch = new_channel(host, port, origin=(self.host, self.port))
+                stub = WorkerStub(ch)
+                self._workers[key] = stub
+                self._channels[key] = ch
+                self._order.append(key)
+                count = len(self._workers)
+        if rereg_stub is not None:
+            # re-introduce the peer set to the (possibly fresh) process: a
+            # restarted worker starts with an EMPTY peer map, and without
+            # this its gossip out-edges would stay gone for the rest of the
+            # fit (it would send deltas only to the master).  add_peer is
+            # idempotent on the worker side, so a redundant registration
+            # retry from a live worker is a no-op fan-out.
+            for oh, op in rereg_others:
+                try:
+                    self.rpc_policy.call_with_retry(
+                        rereg_stub.RegisterSlave, pb.Node(host=oh, port=op),
+                        peer=key, retries=1)
+                except grpc.RpcError as e:
+                    self.log.warning(
+                        "peer re-introduction failed for %s:%d (%s)",
+                        oh, op, e.code())
+            return
         self.log.info("worker registered: %s:%d (%d/%d)",
                       host, port, count, self.expected_workers)
         # full-mesh introduction, both directions (Master.scala:229-233)
@@ -778,6 +827,8 @@ class MasterNode:
         quorum: Optional[int] = None,
         straggler_soft_s: Optional[float] = None,
         hedge: bool = True,
+        fit_state_path: Optional[str] = None,
+        fit_state_every: int = 0,
     ) -> FitResult:
         """Fault-tolerant sync fit, with an optional pipelined wire path.
 
@@ -838,6 +889,22 @@ class MasterNode:
         is slow, not dead (run the heartbeat for liveness).  Default
         `quorum=None` keeps the barrier, wire, and call graph identical
         to the pre-quorum engine.
+
+        Crash-safe fit state (`fit_state_path` + `fit_state_every=R`,
+        DSGD_FIT_CKPT_EVERY, docs/ELASTICITY.md): every R successful
+        windows the FULL loop state — weights, optimizer leaves, epoch +
+        window cursor, sample-draw RNG state, early-stopping history,
+        broadcast version, fit_token lineage — is written atomically to
+        `fit_state_path`.  A restarted master (kill -9 mid-fit) that
+        finds the snapshot waits for worker re-registration (the
+        workers' jittered-backoff loop is storm-safe), issues a NEW
+        fit_token from its fresh incarnation nonce (long-lived workers
+        reset stale per-fit state; the old token joins the lineage),
+        restores the cursor + RNG, and replays from the last completed
+        snapshot — bit-identical to an uninterrupted run at the same
+        step count (tests/test_elastic.py).  `fit_state_every=0`
+        (default) disables snapshots; snapshotting is pure observation
+        (enabled-but-uninterrupted runs land on bit-identical weights).
         """
         if on_worker_death not in ("resplit", "fail"):
             raise ValueError(f"on_worker_death must be resplit|fail, got {on_worker_death!r}")
@@ -907,15 +974,64 @@ class MasterNode:
                 )
             self.log.info("resumed sync fit from checkpoint at epoch %d", start_epoch)
 
-        if start_epoch >= max_epochs:
+        # crash-safe fit state (docs/ELASTICITY.md): a window-cadence
+        # snapshot outranks the epoch-cadence one — it is strictly newer
+        # state (mid-epoch cursor + RNG) written by the same fit
+        resume_batch = 0
+        resume_rng_state = None
+        fit_tokens = [fit_token]
+        fit_state_every = max(0, int(fit_state_every))
+        fs = (restore_fit_state(fit_state_path, opt_kind, expected)
+              if fit_state_path else None)
+        if fs is not None and fs.epoch < start_epoch:
+            # the epoch-cadence checkpoint is strictly newer — possible
+            # when fit_state_every exceeds the windows in an epoch:
+            # resuming from the older window snapshot would re-train
+            # completed, already-checkpointed epochs
+            self.log.info(
+                "fit-state snapshot at epoch %d is older than the epoch "
+                "checkpoint at %d: ignoring it", fs.epoch, start_epoch)
+            fs = None
+        if fs is not None:
+            start_epoch = fs.epoch
+            resume_batch = fs.batch
+            resume_rng_state = fs.rng_state
+            w = np.asarray(fs.weights, dtype=np.float32)
+            test_newest_first = list(fs.test_losses_nf)
+            if opt is not None and fs.opt_leaves:
+                opt_state = jax.tree_util.tree_unflatten(
+                    jax.tree_util.tree_structure(opt_state),
+                    [jnp.asarray(x) for x in fs.opt_leaves],
+                )
+            if bcast.versioned and fs.bcast_version > 0:
+                # continue the version stream: workers key EF retry guards
+                # on step_version, and a restarted master must never reuse
+                # a version its long-lived workers already acknowledged
+                bcast.version = int(fs.bcast_version)
+            fit_tokens = fs.fit_tokens + [fit_token]
+            self.log.info(
+                "resumed crash-safe fit state at epoch %d window cursor %d "
+                "(fit lineage: %d token(s))",
+                start_epoch, resume_batch, len(fit_tokens))
+
+        if start_epoch >= max_epochs or (fs is not None and fs.finished):
+            # nothing to run: the budget is exhausted, OR the snapshot is
+            # the TERMINAL one of a fit that already finished (possibly
+            # early via the convergence criterion at epoch < max_epochs —
+            # resuming such a fit would train PAST convergence and mutate
+            # a finished run's weights)
             loss, acc = self.local_loss(w)
             self.log.info(
-                "checkpoint already at epoch %d >= max_epochs %d: nothing to "
-                "run (loss=%.6f acc=%.4f)", start_epoch, max_epochs, loss, acc)
+                "fit state already %s at epoch %d (max_epochs %d): nothing "
+                "to run (loss=%.6f acc=%.4f)",
+                "finished" if (fs is not None and fs.finished) else "complete",
+                start_epoch, max_epochs, loss, acc)
             result.epochs_run = start_epoch
             result.state = GradState(weights=w, loss=loss).finish()
             return result
 
+        rounds_since_save = 0
+        stopped_early = False
         for epoch in range(start_epoch, max_epochs):
             t0 = time.perf_counter()
             batch = 0
@@ -923,6 +1039,14 @@ class MasterNode:
             # sample stream a fresh run would (mirrors SyncTrainer's
             # fold_in(base_key, epoch))
             rng = np.random.default_rng((self.seed, epoch))
+            if resume_rng_state is not None:
+                # crash-safe resume lands MID-epoch: restore the generator
+                # to its snapshotted state and continue from the window
+                # cursor — the remaining windows draw the identical sample
+                # stream the uninterrupted run would have drawn
+                rng.bit_generator.state = resume_rng_state
+                batch = resume_batch
+                resume_rng_state = None
             while batch < max_samples:
                 # live membership: heartbeat-driven unregister_worker (or a
                 # graceful leave) reaches the loop here, not at fit start
@@ -1081,6 +1205,22 @@ class MasterNode:
                     self.metrics.histogram("master.sync.batch.duration").record(
                         time.perf_counter() - t_batch)
                     batch += window_span
+                    rounds_since_save += 1
+                    if (fit_state_path and fit_state_every
+                            and rounds_since_save >= fit_state_every):
+                        # window-cadence crash snapshot: the cursor points
+                        # PAST the just-applied window, and the RNG state is
+                        # exactly what the next window will draw from
+                        save_fit_state(
+                            fit_state_path, weights=w, epoch=epoch,
+                            batch=batch, rng_state=rng.bit_generator.state,
+                            test_losses_nf=test_newest_first,
+                            opt_kind=opt_kind,
+                            opt_leaves=jax.tree_util.tree_leaves(opt_state)
+                            if opt_state is not None else [],
+                            bcast_version=bcast.version,
+                            fit_tokens=fit_tokens)
+                        rounds_since_save = 0
             epoch_s = time.perf_counter() - t0
 
             loss, acc = self.local_loss(w)
@@ -1101,12 +1241,31 @@ class MasterNode:
                     if opt_state is not None else [])
             if criterion is not None and criterion(test_newest_first):
                 self.log.info("Converged to target: stopping computation")
+                stopped_early = True
                 break
 
         save_sync_fit_final(
             checkpointer, result.epochs_run, start_epoch, checkpoint_every,
             w, test_newest_first, opt_kind,
             jax.tree_util.tree_leaves(opt_state) if opt_state is not None else [])
+        if fit_state_path and fit_state_every:
+            # terminal snapshot: finished marks a CONVERGED fit (criterion
+            # break at epochs_run < max_epochs) so a restart takes the
+            # nothing-to-run path instead of training past convergence —
+            # the epoch cursor alone cannot say this.  Budget exhaustion
+            # is NOT marked: there the cursor carries the same fact
+            # (start_epoch >= max_epochs), and leaving it unmarked lets a
+            # re-run with a RAISED max_epochs resume training, matching
+            # the epoch-checkpoint workflow next door
+            save_fit_state(
+                fit_state_path, weights=w, epoch=result.epochs_run, batch=0,
+                rng_state=np.random.default_rng(
+                    (self.seed, result.epochs_run)).bit_generator.state,
+                test_losses_nf=test_newest_first, opt_kind=opt_kind,
+                opt_leaves=jax.tree_util.tree_leaves(opt_state)
+                if opt_state is not None else [],
+                bcast_version=bcast.version, fit_tokens=fit_tokens,
+                finished=stopped_early)
 
         result.state = GradState(
             weights=w, loss=result.losses[-1] if result.losses else float("nan")
@@ -1326,6 +1485,8 @@ class MasterNode:
         max_stall_interventions: int = 3,
         stall_window_s: Optional[float] = None,
         startup_grace_s: Optional[float] = None,
+        elastic: bool = False,
+        batch_drain: bool = False,
     ) -> FitResult:
         """Async fit with a stall watchdog (superset; the reference counts
         updates blindly, MasterAsync.scala:164-177, and a dead worker means
@@ -1347,7 +1508,27 @@ class MasterNode:
         program (and a misfired kick replaces the loop and recompiles,
         making the stall worse).  Before the first update ever arrives the
         window is `startup_grace_s` (default max(stall_window, 180)) for
-        the same reason.  Tests pass explicit small values."""
+        the same reason.  Tests pass explicit small values.
+
+        Elastic membership (`elastic=True`, DSGD_ELASTIC,
+        docs/ELASTICITY.md): on ANY membership change — a worker evicted,
+        a worker gracefully leaving, or a NEW worker registering mid-fit —
+        the loop re-splits the sample assignment deterministically across
+        the CURRENT members (the same core/split.py strategy the sync
+        resplit path uses) and re-issues StartAsync (with the current
+        weights) only to workers whose slice changed; the gossip plane
+        absorbs the change through the existing full-mesh introduction /
+        unregister broadcast, so a join or leave never stops the world.
+        Off (default) the loop keeps the pre-elastic behavior: evicted
+        workers' slices MERGE into survivors and mid-fit joins idle until
+        the next fit.
+
+        Batch drain (`batch_drain=True`, DSGD_ASYNC_DRAIN): buffer
+        incoming UpdateGrads in an inbox and apply one summed update per
+        drain (deltas commute; mirrors parallel/hogwild.py _drain_inbox),
+        replacing the per-message jitted apply that serializes on
+        _async_lock at high worker counts.  Off (default) keeps the
+        per-message apply byte-identical."""
         if optimizer is not None and not isinstance(optimizer, str):
             raise ValueError(
                 "the RPC topology ships the optimizer by NAME in "
@@ -1406,6 +1587,16 @@ class MasterNode:
         # but alive worker must not keep training (and gossiping into the
         # master) after the fit returns
         ever_assigned = set(assignments)
+        with self._members_lock:
+            self._rereg_pending.clear()  # stale kicks from a prior fit
+        drain_thread = None
+        if batch_drain:
+            with self._inbox_cv:
+                self._inbox.clear()  # never apply a prior fit's stragglers
+                self._drain_on = True
+            drain_thread = threading.Thread(
+                target=self._drain_loop, daemon=True, name="async-drain")
+            drain_thread.start()
         try:
             # fan-out INSIDE the try: a worker dying mid-fan-out must still
             # reach the finally (_end_async_endpoints), or _async_running
@@ -1420,19 +1611,44 @@ class MasterNode:
                     w_now = self._w_async
                 window = (startup_grace_s if updates == start_updates
                           else stall_window_s)
-                # heartbeat eviction reaches the async fit HERE: an assigned
-                # worker that lost membership gets its samples re-issued to a
-                # survivor immediately, without waiting for a full stall
+                # membership reaches the async fit HERE each tick: an
+                # assigned worker that lost membership gets its samples
+                # re-issued immediately (no full-stall wait), and under
+                # `elastic` a JOIN triggers the same deterministic resplit
                 with self._members_lock:
-                    member_keys = set(self._workers)
-                evicted = [k for k in assignments if k not in member_keys]
-                if evicted:
+                    member_order = list(self._order)
+                if elastic:
+                    if set(member_order) != set(assignments):
+                        self._elastic_resplit(
+                            assignments, member_order, np.asarray(w_now),
+                            batch_size, learning_rate, optimizer, momentum,
+                            split, ever_assigned)
+                else:
+                    member_keys = set(member_order)
+                    evicted = [k for k in assignments if k not in member_keys]
+                    if evicted:
+                        self.log.warning(
+                            "async fit: %d assigned worker(s) no longer members; "
+                            "reassigning", len(evicted))
+                        self._reassign_async(assignments, evicted,
+                                             np.asarray(w_now),
+                                             batch_size, learning_rate,
+                                             optimizer, momentum)
+                # same-endpoint restarts: a worker that RE-registered while
+                # still a member left no membership delta for the blocks
+                # above to see — re-kick its current slice (idempotent on a
+                # live worker; see register_worker)
+                with self._members_lock:
+                    rejoined = [k for k in self._rereg_pending
+                                if k in assignments]
+                    self._rereg_pending.clear()
+                for key in rejoined:
                     self.log.warning(
-                        "async fit: %d assigned worker(s) no longer members; "
-                        "reassigning", len(evicted))
-                    self._reassign_async(assignments, evicted, np.asarray(w_now),
-                                         batch_size, learning_rate, optimizer,
-                                         momentum)
+                        "async fit: %s:%d re-registered while assigned; "
+                        "re-issuing its StartAsync", key[0], key[1])
+                    self._try_start_async_worker(
+                        key, assignments[key], np.asarray(w_now),
+                        batch_size, learning_rate, optimizer, momentum)
                 if updates > last_progress:
                     last_progress, last_progress_t = updates, time.monotonic()
                     interventions = 0
@@ -1466,6 +1682,13 @@ class MasterNode:
                     break
         finally:
             self._end_async_endpoints(ever_assigned)
+            if drain_thread is not None:
+                # stop the drain AFTER StopAsync: in-flight gossip drains
+                # into the weights instead of stranding in the inbox
+                with self._inbox_cv:
+                    self._drain_on = False
+                    self._inbox_cv.notify()
+                drain_thread.join(timeout=10.0)
         # BEST weights, not last (MasterAsync.scala:87-94)
         return async_fit_result(
             checker, w0, t_start, self._updates, batch_size, len(self.train))
@@ -1566,6 +1789,45 @@ class MasterNode:
         self._reassign_async(assignments, dead, w_now, batch_size,
                              learning_rate, optimizer, momentum)
 
+    def _elastic_resplit(self, assignments, member_order, w_now, batch_size,
+                         learning_rate, optimizer, momentum, split,
+                         ever_assigned) -> None:
+        """Elastic membership change (docs/ELASTICITY.md): re-split the
+        corpus deterministically across the CURRENT members — the same
+        core/split.py strategy the sync resplit path uses, over the same
+        registration order, so any master looking at the same membership
+        derives the same slices — and re-issue StartAsync (current
+        weights) ONLY to workers whose slice changed.  Workers that kept
+        their slice keep training untouched: a join or leave never stops
+        the world.  Departed workers simply drop out of the assignment
+        map; their peers swept the gossip state when the unregister
+        broadcast landed (worker.remove_peer drops the EF residual, the
+        RPC-sender window is closed)."""
+        if not member_order:
+            raise RuntimeError("async fit: all workers lost mid-fit")
+        parts = split(len(self.train), len(member_order))
+        new_assign = {key: part for key, part in zip(member_order, parts)}
+        changed = [key for key in member_order
+                   if key not in assignments
+                   or not np.array_equal(assignments[key], new_assign[key])]
+        joined = [key for key in member_order if key not in assignments]
+        departed = [key for key in assignments if key not in new_assign]
+        assignments.clear()
+        assignments.update(new_assign)
+        ever_assigned.update(member_order)
+        self.metrics.counter(metrics_mod.ASYNC_RESPLITS).increment()
+        flight.record("async.resplit", members=len(member_order),
+                      joined=len(joined), departed=len(departed),
+                      reissued=len(changed))
+        self.log.warning(
+            "elastic resplit across %d member(s): %d joined, %d departed, "
+            "%d assignment(s) re-issued", len(member_order), len(joined),
+            len(departed), len(changed))
+        for key in changed:
+            self._try_start_async_worker(key, assignments[key], w_now,
+                                         batch_size, learning_rate,
+                                         optimizer, momentum)
+
     def _reassign_async(self, assignments, dead, w_now, batch_size,
                         learning_rate, optimizer, momentum) -> None:
         """Merge each dead worker's samples into a survivor's assignment and
@@ -1604,6 +1866,62 @@ class MasterNode:
                 "async fit: StartAsync re-issue to %s:%d failed (%s); "
                 "evicting — samples reassign next tick", key[0], key[1], code)
             self.unregister_worker(*key, evicted=True)
+
+    # -- batch-drain inbox (docs/ELASTICITY.md) ----------------------------
+
+    # inbox bound, mirroring hogwild's max_inbox=1024: each entry holds a
+    # DENSE dim-sized float32 delta, so an unbounded list would grow the
+    # master's RSS without limit whenever sustained arrival outruns the
+    # single drain thread (exactly the high-worker-count regime the drain
+    # targets)
+    ASYNC_INBOX_CAP = 1024
+
+    def _inbox_put(self, delta: np.ndarray, n_steps: int) -> bool:
+        """Buffer a delta iff the drain thread is accepting AND the inbox
+        has room.  The check happens under the inbox lock — an
+        unsynchronized `_drain_on` read followed by a put could land AFTER
+        the drain thread observed shutdown and exited, stranding the delta
+        in the inbox where the NEXT batch-drain fit would apply it to
+        fresh weights.  Returns False when declined (caller applies
+        per-message: on overflow that keeps every delta counted AND
+        throttles arrival through the jitted apply under `_async_lock` —
+        bounded work, so the gRPC server pool never starves the way a
+        blocking put would)."""
+        with self._inbox_cv:
+            if not self._drain_on or len(self._inbox) >= self.ASYNC_INBOX_CAP:
+                if self._drain_on:
+                    self.metrics.counter(
+                        metrics_mod.ASYNC_DRAIN_FALLBACK).increment()
+                return False
+            self._inbox.append((delta, n_steps))
+            self._inbox_cv.notify()
+            return True
+
+    def _drain_loop(self) -> None:
+        """Batch-drain thread: sum every buffered delta on the host and
+        apply ONE jitted update per drain (deltas commute — the receiving
+        merge sees exactly the per-message subtractions, summed; mirrors
+        parallel/hogwild.py _drain_inbox).  Exits once the fit clears
+        `_drain_on` AND the inbox is empty, so no delta is stranded."""
+        drains = self.metrics.counter(metrics_mod.ASYNC_DRAINS)
+        sizes = self.metrics.histogram(metrics_mod.ASYNC_DRAIN_SIZE)
+        while True:
+            with self._inbox_cv:
+                while not self._inbox and self._drain_on:
+                    self._inbox_cv.wait(timeout=0.25)
+                batch, self._inbox = self._inbox, []
+                if not batch and not self._drain_on:
+                    return
+            if not batch:
+                continue
+            acc = np.array(batch[0][0], dtype=np.float32, copy=True)
+            total = int(batch[0][1])
+            for delta, n in batch[1:]:
+                acc += delta
+                total += int(n)
+            self._update_grad(acc, n_steps=total)
+            drains.increment()
+            sizes.record(len(batch))
 
     # master UpdateGrad RPC (MasterAsync.scala:164-177); one gossip message
     # may carry n_steps summed local steps (dispatch amortization) and
@@ -1651,5 +1969,26 @@ class _MasterServicer:
         # comms.* counters live in the workers' compressors)
         self.m.metrics.counter("master.async.grad.bytes").increment(
             request.ByteSize())
-        self.m._update_grad(codec.decode_grad(request), n_steps=request.n_steps or 1)
+        delta = codec.decode_grad(request)
+        n_steps = request.n_steps or 1
+        # batch-drain mode: decode on the servicer thread (parallel),
+        # buffer for the drain thread's single summed apply; _inbox_put
+        # declines atomically when draining is off (or just shut down)
+        if not self.m._inbox_put(delta, n_steps):
+            self.m._update_grad(delta, n_steps=n_steps)
+        return pb.Ack()
+
+    def Ping(self, request, context):  # noqa: N802
+        # membership probe for the workers' re-registration watch
+        # (docs/ELASTICITY.md): a caller this master does not know gets
+        # NOT_FOUND — the one signal that survives a FAST restart (the
+        # rebound port answers probes before the watch can accumulate
+        # unreachability misses) and an eviction the worker missed
+        if request.host:
+            key = (request.host, request.port)
+            with self.m._members_lock:
+                known = key in self.m._workers
+            if not known:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"{key[0]}:{key[1]} is not a member")
         return pb.Ack()

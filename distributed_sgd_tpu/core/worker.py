@@ -74,6 +74,9 @@ class WorkerNode:
         rpc_policy: Optional[RpcPolicy] = None,
         profile_dir: Optional[str] = None,
         profile_steps: int = 16,
+        gossip_topology: str = "all",
+        master_watch_s: Optional[float] = None,
+        master_watch_misses: int = 3,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -121,6 +124,26 @@ class WorkerNode:
         # parallel/hogwild.py, GradUpdate.n_steps carries k on the wire).
         # k=1 is the reference's per-step gossip (Slave.scala:103-105)
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        # sparse gossip topology (DSGD_GOSSIP_TOPOLOGY, parallel/topology.py,
+        # docs/ELASTICITY.md): which peers receive each dispatch's delta.
+        # "all" (default) keeps the reference's full fan-out byte-identical;
+        # ring/random:k select deterministically per (dispatch, worker) with
+        # breaker-aware reselection around suppressed edges.  The master
+        # ALWAYS receives the delta (budget counting) regardless of mode.
+        from distributed_sgd_tpu.parallel.topology import parse_topology
+
+        self._topo_mode, self._topo_k = parse_topology(gossip_topology)
+        self._dispatch_no = 0
+        # master-membership watch (docs/ELASTICITY.md): when set, a
+        # registered worker probes Master.Ping with its own identity every
+        # `master_watch_s`; after `master_watch_misses` consecutive misses
+        # — or ONE NOT_FOUND from a reachable master that does not know us
+        # (fast restart / missed eviction) — it clears _registered and
+        # re-enters the jittered registration loop, the storm-safe path a
+        # RESTARTED master's workers take back into membership.  None
+        # (default) keeps the one-shot registration of the reference.
+        self._master_watch_s = master_watch_s
+        self._master_watch_misses = max(1, int(master_watch_misses))
 
         # device-resident copy of the full dataset (the reference slave also
         # holds the full data and receives sample indices, Main.scala:138)
@@ -195,21 +218,61 @@ class WorkerNode:
 
     def _register_loop(self) -> None:
         node = pb.Node(host=self.host, port=self.port)
-        attempt = 0
-        while not self._stopped.is_set() and not self._registered.is_set():
-            try:
-                self._master.RegisterSlave(node, timeout=self.rpc_policy.deadline_s)
-                self._registered.set()
-                self.log.info("registered with master")
+        while not self._stopped.is_set():
+            attempt = 0
+            while not self._stopped.is_set() and not self._registered.is_set():
+                try:
+                    self._master.RegisterSlave(
+                        node, timeout=self.rpc_policy.deadline_s)
+                    self._registered.set()
+                    self.log.info("registered with master")
+                except grpc.RpcError as e:
+                    # jittered exponential backoff (policy default: 2 s first
+                    # delay, the reference's fixed retry period,
+                    # Slave.scala:56).  The jitter is what makes a whole
+                    # fleet re-registering after a master restart storm-safe:
+                    # N workers' retries spread over the backoff window
+                    # instead of synchronizing (docs/ELASTICITY.md)
+                    delay = self.rpc_policy.backoff_s(attempt)
+                    attempt += 1
+                    self.log.info("registration failed (%s); retry %d in %.1fs",
+                                  e.code(), attempt, delay)
+                    self._stopped.wait(delay)
+            if self._master_watch_s is None or self._stopped.is_set():
                 return
-            except grpc.RpcError as e:
-                # jittered exponential backoff (policy default: 2 s first
-                # delay, the reference's fixed retry period, Slave.scala:56)
-                delay = self.rpc_policy.backoff_s(attempt)
-                attempt += 1
-                self.log.info("registration failed (%s); retry %d in %.1fs",
-                              e.code(), attempt, delay)
-                self._stopped.wait(delay)
+            # registered + watch enabled: probe the master WITH OUR OWN
+            # identity.  Two distinct loss signals re-enter the
+            # registration loop above: sustained unreachability (slow
+            # restart / partition, counted in misses) and NOT_FOUND — a
+            # reachable master that does not know us (a FAST restart
+            # rebinds the port before misses can accumulate, and an
+            # eviction we missed looks identical), which re-registers
+            # immediately
+            misses = 0
+            while not self._stopped.wait(self._master_watch_s):
+                try:
+                    self._master.Ping(node,
+                                      timeout=self.rpc_policy.deadline_s)
+                    misses = 0
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.NOT_FOUND:
+                        self.log.warning(
+                            "master no longer knows us (restart or "
+                            "eviction); re-registering")
+                        flight.record("master.forgot", worker=self.node_label)
+                        self._registered.clear()
+                        break
+                    misses += 1
+                    if misses >= self._master_watch_misses:
+                        self.log.warning(
+                            "master unreachable for %d probes (%s); "
+                            "re-registering", misses, e.code())
+                        flight.record("master.lost", worker=self.node_label,
+                                      misses=misses)
+                        self._registered.clear()
+                        break
+            if self._registered.is_set():
+                return  # stopped while the watch was healthy
 
     def stop(self) -> None:
         self._stopped.set()
@@ -632,16 +695,47 @@ class WorkerNode:
                               node=self.node_label, k=ksteps):
                 self._gossip_dispatch(delta_np, ksteps)
 
+    def _select_gossip(self):
+        """This dispatch's peer destinations under the configured topology
+        (parallel/topology.py).  'all' returns the live sender map in
+        insertion order — the exact pre-topology iteration, so the default
+        wire is byte- and order-identical; ring/random:k select
+        deterministically per (dispatch, worker) and re-route edges whose
+        breaker is refusing sends (counted + traced)."""
+        with self._peers_lock:
+            senders = dict(self._gossip)
+        if self._topo_mode == "all":
+            return list(senders.items())
+        from distributed_sgd_tpu.parallel import topology as topo
+
+        def _suppressed(key):
+            s = senders.get(key)
+            return (s is not None and s.breaker is not None
+                    and s.breaker.suppressed())
+
+        keys, reselects = topo.select_gossip_peers(
+            self._topo_mode, self._topo_k, list(senders),
+            (self.host, self.port), self._dispatch_no, seed=self.seed,
+            suppressed=_suppressed)
+        if reselects:
+            self.metrics.counter(
+                metrics_mod.TOPOLOGY_RESELECT).increment(reselects)
+            trace_mod.event(trace_mod.EVENT_TOPOLOGY_RESELECT,
+                            node=self.node_label, edges=reselects)
+            flight.record("topology.reselect", worker=self.node_label,
+                          edges=reselects)
+        return [(k, senders[k]) for k in keys]
+
     def _gossip_dispatch(self, delta_np: np.ndarray, ksteps: int) -> None:
-        """One dispatch's delta fan-out to every peer + the master."""
+        """One dispatch's delta fan-out to the topology-selected peers + the
+        master (the master ALWAYS receives: it counts the budget)."""
+        self._dispatch_no += 1
         if self._compressor is None:
             msg = codec.encode_grad(delta_np)
             msg.n_steps = ksteps
-            with self._peers_lock:
-                senders = list(self._gossip.values())
-            for sender in senders:  # fire-and-forget (Slave.scala:103-105),
-                sender.send(msg)    # bounded in-flight, drop-oldest
-            self._master_gossip.send(msg)
+            for _key, sender in self._select_gossip():
+                sender.send(msg)  # fire-and-forget (Slave.scala:103-105),
+            self._master_gossip.send(msg)  # bounded in-flight, drop-oldest
             return
         # per-destination encode: each peer (and the master) has its
         # own error-feedback residual, so the k coordinates shipped
@@ -661,8 +755,7 @@ class WorkerNode:
         # below closes the race where a concurrent remove_peer's
         # residual_drop interleaves with an in-flight compress and
         # the dropped entry gets silently re-created.
-        with self._peers_lock:
-            senders_c = list(self._gossip.items())
+        senders_c = self._select_gossip()
         for peer_key, sender in senders_c:
             msg = self._compressor.compress(
                 delta_np, dest=("peer", peer_key))

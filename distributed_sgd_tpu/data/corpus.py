@@ -20,7 +20,7 @@ corpus; only the value *strings* repeat every ``n_template`` rows.
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Tuple
+from typing import Dict, List, Tuple, Union
 
 import numpy as np
 
@@ -31,14 +31,23 @@ FIRST_DOC_ID = 2286  # real RCV1 ids start here
 
 
 def _template_bodies(
-    n_template: int, nnz_mean: int, n_features: int, rng: np.random.Generator
-) -> Tuple[List[str], np.ndarray]:
+    n_template: int, nnz_mean: int, n_features: int, rng: np.random.Generator,
+    return_debug: bool = False,
+) -> Union[Tuple[List[str], np.ndarray],
+           Tuple[List[str], np.ndarray, Dict[str, np.ndarray]]]:
     """Format `n_template` random row bodies ("f:v f:v ...", 1-based ids).
 
     Returns (bodies, labels): labels come from a planted linear separator
     over the row features (like data/synthetic.rcv1_like), so a corpus
     written from these templates is LEARNABLE — training on the parsed
     files converges, closing the text->parse->train loop end to end.
+
+    `return_debug=True` additionally returns {"w_true", "margins"} so the
+    regression tests (tests/test_data_scale.py) can verify the two
+    ADVICE.md rounding invariants from the OUTSIDE: every emitted token
+    formats nonzero, and each planted margin equals the dot product of
+    the PARSED (file-precision) values with w_true — i.e. the label a
+    reader derives from the file bytes is the label we planted.
     """
     nnz = np.clip(rng.poisson(nnz_mean, size=n_template), 1, None)
     max_nnz = int(nnz.max())
@@ -99,6 +108,8 @@ def _template_bodies(
             " ".join(f"{c + 1}:{v:.6f}" for c, v in zip(row_idx, row_val))
         )
     labels = np.where(margins > np.median(margins), 1, -1).astype(np.int32)
+    if return_debug:
+        return bodies, labels, {"w_true": w_true, "margins": margins}
     return bodies, labels
 
 

@@ -170,6 +170,20 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             "DSGD_QUORUM/DSGD_CHAOS ignored: the quorum barrier and the "
             "fault-injection layer live on the rpc topology's wire "
             "(use engine=rpc)")
+    if cfg.elastic or cfg.async_drain or cfg.fit_ckpt_every:
+        # elastic membership, the batch-drain inbox, and the crash-safe
+        # fit-state snapshot all live on the rpc control plane
+        log.warning(
+            "DSGD_ELASTIC/DSGD_ASYNC_DRAIN/DSGD_FIT_CKPT_EVERY ignored: "
+            "the elastic + crash-recovery subsystem is the rpc topology's "
+            "(use engine=rpc; docs/ELASTICITY.md)")
+    if (cfg.gossip_topology != "all"
+            and not (cfg.use_async and cfg.async_mode == "gossip")):
+        # only the gossip plane has peer fan-out to sparsify
+        log.warning(
+            "DSGD_GOSSIP_TOPOLOGY=%s ignored: only the gossip engines "
+            "(async_mode=gossip or engine=rpc async) have a peer fan-out",
+            cfg.gossip_topology)
     log.info(
         "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
         n, virtual, cfg.kernel, cfg.model, cfg.use_async,
@@ -207,6 +221,7 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             optimizer=cfg.optimizer, momentum=cfg.momentum,
             compress=cfg.compress, compress_k=cfg.compress_k,
             compress_ef=cfg.compress_ef,
+            gossip_topology=cfg.gossip_topology,
         )
         res = eng.fit(train, test, cfg.max_epochs, criterion,
                       initial_weights=_restore_weights(ckpt))
@@ -238,6 +253,17 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     _finish(cfg, res, saved=ckpt is not None)
 
 
+def _fit_state_args(cfg: Config) -> dict:
+    """DSGD_FIT_CKPT_EVERY -> fit_sync crash-snapshot kwargs (empty when
+    disabled; config validation already required checkpoint_dir)."""
+    if not cfg.fit_ckpt_every or not cfg.checkpoint_dir:
+        return {}
+    from distributed_sgd_tpu.checkpoint import fit_state_path
+
+    return {"fit_state_path": fit_state_path(cfg.checkpoint_dir),
+            "fit_state_every": cfg.fit_ckpt_every}
+
+
 def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     """Dev-mode reference-parity path: in-process gRPC cluster."""
     from distributed_sgd_tpu.core.cluster import DevCluster
@@ -248,7 +274,8 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                     heartbeat_max_misses=cfg.heartbeat_max_misses,
                     steps_per_dispatch=cfg.steps_per_dispatch,
                     compress=cfg.compress, compress_k=cfg.compress_k,
-                    compress_ef=cfg.compress_ef, chaos=cfg.chaos) as c:
+                    compress_ef=cfg.compress_ef, chaos=cfg.chaos,
+                    gossip_topology=cfg.gossip_topology) as c:
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
@@ -259,6 +286,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
                 initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
                 optimizer=cfg.optimizer, momentum=cfg.momentum,
+                elastic=cfg.elastic, batch_drain=cfg.async_drain,
             )
         else:
             res = c.master.fit_sync(
@@ -268,6 +296,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
                 quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
+                **_fit_state_args(cfg),
             )
         _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True),
                 saved=ckpt is not None)
@@ -418,6 +447,7 @@ def _run_role(cfg: Config, role: str) -> None:
                 check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
                 initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
                 optimizer=cfg.optimizer, momentum=cfg.momentum,
+                elastic=cfg.elastic, batch_drain=cfg.async_drain,
             )
         else:
             res = master.fit_sync(
@@ -427,6 +457,7 @@ def _run_role(cfg: Config, role: str) -> None:
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
                 quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
+                **_fit_state_args(cfg),
             )
         _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True),
                 saved=ckpt is not None)
@@ -444,6 +475,11 @@ def _run_role(cfg: Config, role: str) -> None:
             # DSGD_PROFILE_DIR on the worker role: device trace of the
             # first dispatches — where distributed time actually goes
             profile_dir=cfg.profile_dir,
+            gossip_topology=cfg.gossip_topology,
+            # elastic deployments survive a master restart: the watch
+            # probes Master.Ping and re-enters the jittered registration
+            # loop on sustained loss (docs/ELASTICITY.md)
+            master_watch_s=(cfg.heartbeat_s or 5.0) if cfg.elastic else None,
         ).start()
         worker.await_termination()
 

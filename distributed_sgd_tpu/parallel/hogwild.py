@@ -80,10 +80,22 @@ class _Worker:
         optimizer=None,
         momentum: float = 0.9,
         compressor=None,
+        gossip_topology: str = "all",
     ):
         self.wid = wid
         self.device = device
         self.metrics = metrics
+        # sparse gossip topology (parallel/topology.py): which peers this
+        # worker's dispatch gossips to.  "all" keeps the reference's full
+        # fan-out; ring/random:k select deterministically per (dispatch,
+        # wid) — the in-process twin of the RPC workers' selection, so the
+        # convergence-parity gate (benches/bench_elastic.py) measures the
+        # same edge schedule the wire plane would run.
+        from distributed_sgd_tpu.parallel.topology import parse_topology
+
+        self._topo_mode, self._topo_k = parse_topology(gossip_topology)
+        self._topo_seed = seed
+        self._dispatch_no = 0
         # wire-path gradient compression (compress/): this worker's OWN
         # instance — residuals are per (worker, destination), never shared
         self._compressor = compressor
@@ -229,6 +241,20 @@ class _Worker:
                 self.w = self._apply(self.w, jnp.asarray(acc))
             self.metrics.counter("slave.async.grad.update").increment(n)
 
+    def _gossip_peers(self) -> List["_Worker"]:
+        """This dispatch's destinations under the configured topology; the
+        'all' path returns the connected list untouched (byte-identical
+        default)."""
+        if self._topo_mode == "all" or not self._peers:
+            return self._peers
+        from distributed_sgd_tpu.parallel.topology import select_gossip_peers
+
+        by_wid = {p.wid: p for p in self._peers}
+        sel, _ = select_gossip_peers(
+            self._topo_mode, self._topo_k, list(by_wid), self.wid,
+            self._dispatch_no, seed=self._topo_seed)
+        return [by_wid[w] for w in sel]
+
     def _loop(self) -> None:
         while self._running.is_set():
             self._drain_inbox()
@@ -240,8 +266,10 @@ class _Worker:
                 self.w = self._apply(self.w, delta)
             self.metrics.counter("slave.async.batch").increment(self.k)
             delta_np = np.asarray(delta)  # host hop = the wire serialization
+            self._dispatch_no += 1
+            peers = self._gossip_peers()
             if self._compressor is None:
-                for peer in self._peers:
+                for peer in peers:
                     peer.push_delta(delta_np)
                 if self._master is not None:
                     self._master._update_grad(delta_np, n_steps=self.k)
@@ -256,7 +284,7 @@ class _Worker:
                 # the commutative subtractions Hogwild needs.
                 from distributed_sgd_tpu.rpc import codec as _codec  # cached after first loop
 
-                for peer in self._peers:
+                for peer in peers:
                     msg = self._compressor.compress(
                         delta_np, dest=("peer", peer.wid))
                     peer.push_delta(_codec.decode_grad(msg))
@@ -289,6 +317,7 @@ class HogwildEngine:
         compress: str = "none",
         compress_k: float = 0.01,
         compress_ef: bool = True,
+        gossip_topology: str = "all",
     ):
         """steps_per_dispatch=k amortizes host dispatch: each worker runs k
         local SGD steps in one compiled program and gossips the summed
@@ -306,11 +335,20 @@ class HogwildEngine:
         its own compressor with per-destination error-feedback residuals,
         and every destination receives the decoded lossy delta its encode
         produced — the in-process analogue of the RPC topology's
-        compressed UpdateGrad stream (docs/COMPRESSION.md)."""
+        compressed UpdateGrad stream (docs/COMPRESSION.md).
+
+        `gossip_topology` (DSGD_GOSSIP_TOPOLOGY, docs/ELASTICITY.md):
+        all (default, the reference's full fan-out) | ring | random:k —
+        sparse peer selection per dispatch, deterministic per (dispatch,
+        wid); the coordinator always receives every delta regardless."""
         if not (0.0 <= leaky_loss <= 1.0):
             raise ValueError("leaking coefficient must be between 0 and 1")
         if steps_per_dispatch < 1:
             raise ValueError("steps_per_dispatch must be >= 1")
+        from distributed_sgd_tpu.parallel.topology import parse_topology
+
+        parse_topology(gossip_topology)  # fail typos at construction
+        self.gossip_topology = gossip_topology
         self.model = model
         self.n_workers = n_workers
         self.batch_size = batch_size
@@ -416,6 +454,7 @@ class HogwildEngine:
                     self.compress, k=self.compress_k,
                     error_feedback=self.compress_ef, seed=self.seed + i,
                     metrics=self.metrics),
+                gossip_topology=self.gossip_topology,
             )
             for i in range(self.n_workers)
         ]

@@ -1,0 +1,139 @@
+"""Sparse gossip topologies for the async delta-gossip plane
+(DSGD_GOSSIP_TOPOLOGY; docs/ELASTICITY.md).
+
+The reference gossips all-to-all (Slave.scala:103-105): every worker
+sends every delta to every peer, O(N^2) messages per dispatch — fine at
+nodeCount=3, fatal at production worker counts.  This module picks, per
+dispatch, WHICH peers receive a worker's summed delta:
+
+- ``all``       (default) every peer, in canonical sorted order — the
+                reference wire, byte-identical message set;
+- ``ring``      the worker's successor on the ring of sorted member ids:
+                one message per dispatch, deltas propagate around the
+                ring within N dispatches (deltas commute, so summed
+                relay order is irrelevant — only staleness grows, and
+                it is bounded by the ring diameter);
+- ``random:k``  k peers drawn without replacement from a deterministic
+                per-(round, worker) RNG stream: expected O(Nk) messages
+                per dispatch with Erdos-Renyi-style mixing (a random
+                k-out graph is connected w.h.p. for k >= 2).
+
+Selection is a PURE function of (mode, sorted peer ids, self id, round,
+seed) — two workers with the same view select the same edges on the same
+round, a resumed/rejoined worker re-derives its schedule, and tests can
+predict every edge.  Membership churn simply changes the peer list the
+next dispatch sorts.
+
+Breaker-aware reselection: a selected peer whose circuit breaker is
+refusing sends (PR 4 RpcPolicy, rpc/service.py) would silently lose its
+edge for the whole cooldown — on a sparse graph that can disconnect a
+node.  `select_gossip_peers` therefore walks the deterministic candidate
+order past suppressed peers, substituting the next non-suppressed
+candidate and reporting how many edges were re-routed (counted under
+``slave.async.topology.reselect`` and attached to the gossip span as a
+trace event).  The master is NOT part of this selection: every worker
+always sends its delta to the master (budget counting,
+MasterAsync.scala:164-177) regardless of topology.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+TOPOLOGY_CHOICES = ("all", "ring", "random")
+
+
+def parse_topology(spec: str) -> Tuple[str, int]:
+    """'all' | 'ring' | 'random:k' -> (mode, k).  Raises ValueError on
+    typos so config construction fails fast (config.py __post_init__)."""
+    spec = (spec or "all").strip().lower()
+    if spec in ("all", "ring"):
+        return spec, 0
+    mode, _, karg = spec.partition(":")
+    if mode == "random":
+        try:
+            k = int(karg)
+        except ValueError:
+            raise ValueError(
+                f"DSGD_GOSSIP_TOPOLOGY={spec!r}: random needs an integer "
+                f"fan-out, e.g. random:2") from None
+        if k < 1:
+            raise ValueError(
+                f"DSGD_GOSSIP_TOPOLOGY={spec!r}: random fan-out must be >= 1")
+        return "random", k
+    raise ValueError(
+        f"DSGD_GOSSIP_TOPOLOGY={spec!r} must be all | ring | random:k")
+
+
+def node_id(key) -> int:
+    """Stable integer identity for an endpoint key (RPC (host, port) tuples
+    hash differently per process run; crc32 of the canonical string does
+    not).  Integers (hogwild wids) pass through."""
+    if isinstance(key, int):
+        return key
+    if isinstance(key, tuple):
+        key = f"{key[0]}:{key[1]}"
+    return zlib.crc32(str(key).encode())
+
+
+def select_gossip_peers(
+    mode: str,
+    k: int,
+    peers: Sequence,
+    self_key,
+    round_idx: int,
+    seed: int = 0,
+    suppressed: Optional[Callable[[object], bool]] = None,
+) -> Tuple[List, int]:
+    """Pick this dispatch's gossip destinations from `peers`.
+
+    Returns (selected_keys, reselects): `selected_keys` preserves the
+    canonical sorted order (float-free here, but the RPC sender iterates
+    it and per-destination EF residuals key on it, so a stable order
+    keeps runs reproducible); `reselects` counts edges that were
+    re-routed past a suppressed peer.  With `mode='all'` the full sorted
+    peer list returns untouched and `suppressed` is never consulted —
+    the knobs-off path adds exactly one sort of an already-sorted-ish
+    small list and no RNG draw.
+    """
+    ordered = sorted(peers, key=lambda p: (node_id(p), str(p)))
+    if mode == "all" or not ordered:
+        return list(ordered), 0
+    if mode == "ring":
+        # successor on the ring of (peers + self) sorted by id; walking
+        # past suppressed peers keeps the ring connected through an open
+        # breaker (the suppressed edge re-routes to the next-next node)
+        ring = sorted(ordered + [self_key], key=lambda p: (node_id(p), str(p)))
+        start = ring.index(self_key)
+        candidates = [ring[(start + i) % len(ring)] for i in range(1, len(ring))]
+        candidates = [c for c in candidates if c != self_key]
+    elif mode == "random":
+        rng = np.random.default_rng(
+            (int(seed) & 0xFFFFFFFF, int(round_idx) & 0xFFFFFFFFFFFF,
+             node_id(self_key)))
+        candidates = [ordered[i] for i in rng.permutation(len(ordered))]
+    else:
+        raise ValueError(f"unknown gossip topology mode {mode!r}")
+    want = 1 if mode == "ring" else min(k, len(candidates))
+    selected: List = []
+    reselects = 0
+    for cand in candidates:
+        if len(selected) >= want:
+            break
+        if suppressed is not None and suppressed(cand):
+            reselects += 1
+            continue
+        selected.append(cand)
+    # every candidate suppressed: fall back to the head of the candidate
+    # order (the send itself will be suppressed-and-counted by the
+    # breaker-aware GossipSender — losing the edge entirely would hide
+    # the suppression from the metrics that diagnose it)
+    if not selected and candidates:
+        selected = candidates[:want]
+        reselects = 0
+    order = {node_id(p): i for i, p in enumerate(ordered)}
+    selected.sort(key=lambda p: (order.get(node_id(p), len(order)), str(p)))
+    return selected, reselects

@@ -76,6 +76,20 @@ class CircuitBreaker:
             self._probe_at = now
             return True
 
+    def suppressed(self) -> bool:
+        """Would `allow()` refuse a call right now?  READ-ONLY: unlike
+        allow() this never transitions OPEN->HALF_OPEN and never consumes
+        the half-open probe slot, so the sparse-gossip topology layer can
+        route around a tripped peer (parallel/topology.py reselection)
+        without stealing the probe that would eventually heal it."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return False
+            now = time.monotonic()
+            if self._state == self.OPEN:
+                return now - self._opened_at < self.reset_s
+            return self._probe_inflight and now - self._probe_at < self.reset_s
+
     def record_ok(self) -> None:
         with self._lock:
             self._state = self.CLOSED
@@ -221,6 +235,15 @@ _MASTER_METHODS = {
     "RegisterSlave": (pb.Node, pb.Ack),
     "UnregisterSlave": (pb.Node, pb.Ack),
     "UpdateGrad": (pb.GradUpdate, pb.Ack),
+    # master membership probe for the workers' re-registration watch
+    # (docs/ELASTICITY.md): the worker sends its own Node identity and a
+    # reachable master that does NOT know the caller answers NOT_FOUND —
+    # the signal that survives a fast restart rebinding the same port
+    # (plain unreachability would never trip: the new master answers).
+    # Reuses the Node/Ack pair, no new proto message; an older master
+    # answers UNIMPLEMENTED, which the watch treats as a miss only when
+    # explicitly enabled (master_watch_s)
+    "Ping": (pb.Node, pb.Ack),
 }
 
 _WORKER_METHODS = {

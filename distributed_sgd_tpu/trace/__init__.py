@@ -62,6 +62,7 @@ EVENT_QUORUM_LATE = "quorum.late"          # late reply discarded
 EVENT_BARRIER_STALLED = "barrier.stalled"  # soft deadline overrun, no relief
 EVENT_BCAST_STALE = "bcast.stale"          # stale replica -> full fallback
 EVENT_EF_ROLLBACK = "ef.rollback"          # worker rolled back an EF drain
+EVENT_TOPOLOGY_RESELECT = "topology.reselect"  # gossip edge re-routed past a breaker
 
 
 class TraceContext(NamedTuple):

@@ -19,6 +19,7 @@ registry, plus two exporters:
 
 from __future__ import annotations
 
+import bisect
 import http.server
 import math
 import random
@@ -46,7 +47,8 @@ class Counter:
 
 
 class Histogram:
-    """Streaming histogram: count/sum/min/max/mean/last + quantiles.
+    """Streaming histogram: count/sum/min/max/mean/last + quantiles +
+    fixed log-spaced buckets.
 
     The reference's Kamon histograms feed Grafana percentile panels; the
     cheap streaming aggregates cover mean-style dashboards, and a fixed-size
@@ -55,13 +57,26 @@ class Histogram:
     count <= 512, an unbiased uniform sample of the full stream after; both
     exporters emit the estimates.  The reservoir RNG is seeded from the
     instrument name, so a replayed value stream reproduces its quantiles.
+
+    Buckets (VERDICT item 6, docs/OBSERVABILITY.md): every recorded value
+    also lands in one of `BUCKET_BOUNDS` — three log-spaced bounds per
+    decade over [1e-6, 1e7], wide enough for seconds, bytes, losses, and
+    counts — from which the Prometheus exporter emits a REAL `le`-bucketed
+    cumulative histogram family (``<name>_hist_bucket``), so PromQL
+    ``histogram_quantile`` works server-side on top of the client-side
+    reservoir estimates.  Unlike the reservoir, bucket counts never
+    subsample: they are exact over the full stream.
     """
 
     RESERVOIR_SIZE = 512
     QUANTILES = (0.5, 0.95, 0.99)
+    # 3 bounds per decade, 1e-6 .. 1e7; values beyond the last bound count
+    # only in the implicit +Inf bucket (values <= 1e-6, including zero and
+    # negatives, land in the first)
+    BUCKET_BOUNDS = tuple(10.0 ** (k / 3.0) for k in range(-18, 22))
 
     __slots__ = ("name", "count", "sum", "min", "max", "last", "_reservoir",
-                 "_rng", "_lock")
+                 "_rng", "_lock", "_buckets")
 
     def __init__(self, name: str):
         self.name = name
@@ -73,6 +88,7 @@ class Histogram:
         self._reservoir: List[float] = []
         self._rng = random.Random(zlib.crc32(name.encode()))
         self._lock = threading.Lock()
+        self._buckets = [0] * len(self.BUCKET_BOUNDS)
 
     def record(self, v: float) -> None:
         v = float(v)
@@ -82,12 +98,21 @@ class Histogram:
             self.min = min(self.min, v)
             self.max = max(self.max, v)
             self.last = v
+            i = bisect.bisect_left(self.BUCKET_BOUNDS, v)
+            if i < len(self._buckets):
+                self._buckets[i] += 1  # past the last bound: +Inf only
             if len(self._reservoir) < self.RESERVOIR_SIZE:
                 self._reservoir.append(v)
             else:  # algorithm R: keep slot j with probability SIZE/count
                 j = self._rng.randrange(self.count)
                 if j < self.RESERVOIR_SIZE:
                     self._reservoir[j] = v
+
+    def bucket_counts(self) -> List[int]:
+        """Per-bucket (non-cumulative) counts, snapshot under the lock;
+        `count - sum(bucket_counts())` is the +Inf-only tail."""
+        with self._lock:
+            return list(self._buckets)
 
     @property
     def mean(self) -> float:
@@ -206,6 +231,22 @@ class Metrics:
                 lines.append(f"{base}_min{tagstr} {h.min}")
                 lines.append(f"# TYPE {base}_max gauge")
                 lines.append(f"{base}_max{tagstr} {h.max}")
+                # real le-bucketed histogram as a PARALLEL family (the
+                # summary family above keeps its name/samples for existing
+                # dashboards — same migration discipline as the `_total`
+                # counters): cumulative fixed log-spaced buckets, exact
+                # over the full stream, so server-side
+                # histogram_quantile() works (VERDICT item 6)
+                lines.append(f"# TYPE {base}_hist histogram")
+                cum = 0
+                for le, n in zip(Histogram.BUCKET_BOUNDS, h.bucket_counts()):
+                    cum += n
+                    btags = ",".join(filter(None, [tags, f'le="{le:.9g}"']))
+                    lines.append(f"{base}_hist_bucket{{{btags}}} {cum}")
+                inf_tags = ",".join(filter(None, [tags, 'le="+Inf"']))
+                lines.append(f"{base}_hist_bucket{{{inf_tags}}} {h.count}")
+                lines.append(f"{base}_hist_sum{tagstr} {h.sum}")
+                lines.append(f"{base}_hist_count{tagstr} {h.count}")
         return "\n".join(lines) + "\n"
 
     def influx_lines(self, ts_ns: Optional[int] = None) -> str:
@@ -292,6 +333,17 @@ QUORUM_LATE = "master.sync.quorum.late"            # late replies discarded idem
 SYNC_STALLED = "master.sync.barrier.stalled"       # soft-deadline overruns, no relief
 BREAKER_OPEN = "rpc.breaker.open"                  # breaker trips (service.py)
 GOSSIP_SUPPRESSED = "slave.async.grad.suppressed"  # sends refused by an open breaker
+
+# -- elastic async + sparse gossip topology (docs/ELASTICITY.md) --------------
+#
+# Master-side instruments for the elastic membership loop (fit_async
+# elastic=True resplits), the batch-drain inbox (one summed apply per
+# drain), and the worker-side topology layer (DSGD_GOSSIP_TOPOLOGY).
+ASYNC_RESPLITS = "master.async.resplit"            # elastic membership resplits
+ASYNC_DRAINS = "master.async.drain.batches"        # inbox drains applied
+ASYNC_DRAIN_SIZE = "master.async.drain.size"       # histogram: messages per drain
+ASYNC_DRAIN_FALLBACK = "master.async.drain.fallback"  # full inbox -> per-message
+TOPOLOGY_RESELECT = "slave.async.topology.reselect"  # edges re-routed past breakers
 
 
 _GLOBAL = Metrics()

@@ -67,18 +67,32 @@ def _fit_async_in_thread(master, **kwargs):
 def test_async_rpc_kill_one_of_three_completes_budget(data):
     """Kill 1 of 3 RPC workers mid-fit (heartbeat running): the master
     evicts it, re-issues its samples to a survivor, and the lifetime
-    budget still completes — no infinite spin."""
+    budget still completes — no infinite spin.
+
+    Deflaked (PR 6): heartbeat_s=0.1 granted every probe a 100 ms
+    deadline, so under full-suite load three consecutive slow replies
+    falsely evicted LIVE survivors and collapsed the membership mid-fit.
+    A dead worker fails its probe instantly (connection refused), so a
+    longer interval + higher miss threshold keeps corpse detection at
+    ~2 s while false eviction now needs 2 s of sustained
+    unresponsiveness; the kill->eviction handoff is awaited explicitly
+    instead of racing the budget.  steps_per_dispatch=8 amortizes the
+    gossip (8x fewer messages per local step) so the 40-epoch budget
+    fits tier-1 wall time — the kill still lands mid-fit, and the
+    heartbeat owns eviction independently of the fit loop."""
     train, test = data
-    with DevCluster(_model(), train, test, n_workers=3,
-                    heartbeat_s=0.1) as c:
+    with DevCluster(_model(), train, test, n_workers=3, steps_per_dispatch=8,
+                    heartbeat_s=0.25, heartbeat_max_misses=8) as c:
         max_epochs = 40
         t, box = _fit_async_in_thread(
             c.master, max_epochs=max_epochs, batch_size=8, learning_rate=0.02,
             check_every=200, backoff_s=0.05, stall_checks=4,
         )
-        _await(lambda: c.master._updates > 50, msg="first updates")
+        _await(lambda: c.master._updates > 50, timeout=60, msg="first updates")
         victim = c.workers[0]
         _hard_kill_async(victim)
+        _await(lambda: (victim.host, victim.port) not in c.master._workers,
+               timeout=90, msg="victim eviction")
         t.join(timeout=120)
         assert not t.is_alive(), "fit_async did not terminate"
         assert "exc" not in box, f"fit_async raised: {box.get('exc')}"

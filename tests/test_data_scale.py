@@ -129,3 +129,48 @@ def test_text_corpus_to_async_convergence_end_to_end(small_corpus):
     assert res.state.updates >= len(train) * 2
     assert res.test_accuracies[-1] > 0.75, res.test_accuracies
     assert np.isfinite(res.state.loss)
+
+
+# -- ADVICE.md rounding invariants (data/corpus.py template bodies) ----------
+
+
+def test_corpus_tokens_never_format_to_zero():
+    """ADVICE.md corpus finding 1: the keep floor and the degenerate
+    fallback sit at 1e-6 — the smallest value %.6f preserves — so NO
+    emitted f:v token may read 0.000000 (the reference decodes rows into
+    a map; a zero-valued token contradicts real RCV1 files)."""
+    from distributed_sgd_tpu.data.corpus import _template_bodies
+
+    rng = np.random.default_rng(17)
+    bodies, labels, dbg = _template_bodies(64, 8, 512, rng, return_debug=True)
+    assert len(bodies) == 64 and len(labels) == 64
+    for body in bodies:
+        assert ":0.000000" not in body, body
+        for tok in body.split():
+            fid, _, val = tok.partition(":")
+            assert int(fid) >= 1
+            assert float(val) > 0.0, tok
+
+
+def test_corpus_margins_match_parsed_file_values():
+    """ADVICE.md corpus finding 2: the planted margin must see exactly
+    the values a parser reads back from the file text — row values are
+    rounded to the %.6f wire precision BEFORE the dot with w_true, so
+    the label derived from file bytes is the label we planted, even for
+    rows near the median margin at noise=0."""
+    from distributed_sgd_tpu.data.corpus import _template_bodies
+
+    rng = np.random.default_rng(23)
+    bodies, labels, dbg = _template_bodies(48, 8, 256, rng, return_debug=True)
+    w_true, margins = dbg["w_true"], dbg["margins"]
+    reparsed = np.zeros(len(bodies))
+    for r, body in enumerate(bodies):
+        for tok in body.split():
+            fid, _, val = tok.partition(":")
+            reparsed[r] += float(val) * w_true[int(fid) - 1]
+    # bit-level: the emitted text is %.6f of values already rounded to 6
+    # decimals, so parse-back reproduces the exact floats the margin saw
+    np.testing.assert_allclose(reparsed, margins, rtol=0, atol=1e-12)
+    # and the labels follow the parsed margins exactly
+    expect = np.where(margins > np.median(margins), 1, -1)
+    assert np.array_equal(labels, expect)

@@ -112,6 +112,51 @@ def test_prometheus_counters_emit_total_and_legacy_families():
     assert "\nmaster_sync_rounds 5" in text
 
 
+def test_prometheus_histogram_emits_real_le_buckets():
+    """VERDICT item 6: histograms export a REAL cumulative `le`-bucketed
+    family (`<name>_hist_bucket` + `_sum`/`_count`) alongside the
+    reservoir-quantile summary, so PromQL histogram_quantile works
+    server-side.  Bucket counts are exact (never reservoir-subsampled),
+    cumulative counts are monotone, and +Inf equals the total count."""
+    from distributed_sgd_tpu.utils.metrics import Histogram
+
+    m = Metrics(tags={"node": "w0"})
+    h = m.histogram("rpc.wait")
+    values = [1e-7, 0.003, 0.003, 0.7, 42.0, 1e9]  # spans under/overflow
+    for v in values:
+        h.record(v)
+    # exact per-bucket counts: each value lands in the first bound >= it;
+    # 1e9 is past the last bound so it exists ONLY in +Inf
+    assert sum(h.bucket_counts()) == len(values) - 1
+    text = m.prometheus_text()
+    bucket_re = re.compile(
+        r'rpc_wait_hist_bucket\{node="w0",le="([^"]+)"\} (\d+)')
+    buckets = [(le, int(n)) for le, n in bucket_re.findall(text)]
+    assert len(buckets) == len(Histogram.BUCKET_BOUNDS) + 1
+    counts = [n for _, n in buckets]
+    assert counts == sorted(counts), "cumulative counts must be monotone"
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == len(values)
+    # spot-check the cumulative semantics against the bounds themselves
+    for le_s, n in buckets[:-1]:
+        expect = sum(1 for v in values if v <= float(le_s))
+        assert n == expect, (le_s, n, expect)
+    assert f'rpc_wait_hist_count{{node="w0"}} {len(values)}' in text
+    assert 'rpc_wait_hist_sum{node="w0"}' in text
+    # the legacy reservoir summary family survives alongside
+    assert 'rpc_wait{node="w0",quantile="0.5"}' in text
+
+
+def test_histogram_bucket_counts_are_exact_beyond_reservoir():
+    """The reservoir subsamples past 512 values; the buckets must not."""
+    from distributed_sgd_tpu.utils.metrics import Histogram
+
+    h = Histogram("x")
+    for _ in range(2000):
+        h.record(0.01)
+    assert len(h._reservoir) == Histogram.RESERVOIR_SIZE
+    assert sum(h.bucket_counts()) == 2000
+
+
 def test_prometheus_exporter_routes_metrics_path_only():
     m = Metrics()
     m.counter("serve.rejected").increment()

@@ -167,3 +167,44 @@ def test_epoch_sampling_with_virtual_workers():
     bound = eng.bind(data)
     w = bound.epoch(jnp.zeros(d, dtype=jnp.float32), jax.random.PRNGKey(1))
     assert np.all(np.isfinite(np.asarray(w)))
+
+
+# -- wrap/sampling-bias bound (VERDICT item 7; core/split.py) -----------------
+
+
+def test_sampling_bias_bound_formula():
+    """`sampling_bias_bound` = largest / smallest NON-EMPTY partition of
+    the vanilla ceil-split: 1.0 when k | n, ceil(n/k)/trailing otherwise,
+    and unbounded growth at the adversarial n = (k-1)*ceil(n/k) + 1."""
+    from distributed_sgd_tpu.core.split import sampling_bias_bound, vanilla_split
+
+    assert sampling_bias_bound(12, 3) == 1.0       # even split: no bias
+    assert sampling_bias_bound(10, 3) == 2.0       # sizes 4,4,2
+    # adversarial shape: trailing group degenerates to ONE sample (needs
+    # size <= k so ceil(n/k) stays `size` at n = (k-1)*size + 1)
+    k, size = 8, 4
+    n = (k - 1) * size + 1
+    assert sampling_bias_bound(n, k) == float(size)
+    # empty trailing partitions hold no samples and must not divide by 0
+    assert sampling_bias_bound(4, 8) == 1.0        # 4 groups of 1 + 4 empty
+    assert sampling_bias_bound(0, 3) == 1.0
+    # the bound is exactly max/min over the REAL partition sizes
+    for n, k in ((100, 7), (23, 5), (64, 8), (9, 4)):
+        sizes = [len(p) for p in vanilla_split(n, k) if len(p)]
+        assert sampling_bias_bound(n, k) == max(sizes) / min(sizes)
+
+
+def test_sampling_bias_bound_matches_fanin_weighting():
+    """The documented meaning: equal per-worker averaging (1/k) over
+    per-partition uniform draws gives sample s an effective per-window
+    inclusion weight proportional to 1/|partition(s)| — so the max/min
+    per-sample weight ratio across the corpus IS the bound."""
+    from distributed_sgd_tpu.core.split import sampling_bias_bound, vanilla_split
+
+    n, k = 10, 3  # partitions 4, 4, 2: trailing samples weigh 2x
+    parts = vanilla_split(n, k)
+    weight = np.zeros(n)
+    for p in parts:
+        if len(p):
+            weight[p] = 1.0 / (k * len(p))
+    assert weight.max() / weight.min() == sampling_bias_bound(n, k) == 2.0
