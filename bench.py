@@ -318,6 +318,16 @@ def main() -> None:
 
         bench_rpc_sync.main(smoke="--smoke" in sys.argv)
         return
+    if "--telemetry" in sys.argv:
+        # cluster-telemetry gate (docs/OBSERVABILITY.md): the rpc sync
+        # workload telemetry-off vs fully on (per-node registries, worker
+        # health gauges, health monitor, endpoint polled at Prometheus
+        # cadence); hard-asserts <5% overhead AND that the endpoint served
+        # the per-worker series.  --smoke is the CI-sized mode.
+        from benches import bench_telemetry
+
+        bench_telemetry.main(smoke="--smoke" in sys.argv)
+        return
     if "--trace-overhead" in sys.argv:
         # tracing-overhead gate (docs/OBSERVABILITY.md): the rpc sync
         # workload with the tracer unconfigured vs fully on (sample=1.0);

@@ -7,13 +7,29 @@ download is already proven on generated files in the reference's exact
 text format (data/corpus.py + benches/data_pipeline.py).  This script
 makes closing the gap turnkey for whoever has network:
 
-    python benches/real_rcv1.py            # download -> parse gate ->
-                                           # full scenario -> bench ->
-                                           # append BASELINE.md section
+    python benches/real_rcv1.py            # download -> checksum verify ->
+                                           # parse gate -> full scenario ->
+                                           # bench -> append BASELINE.md
+    python benches/real_rcv1.py --slice 50000
+                                           # same, but fit/bench on the
+                                           # first 50k parsed rows — the
+                                           # one-command verification run
+                                           # for the FIRST egress-enabled
+                                           # attempt (parse still runs at
+                                           # full scale against its gate)
     python benches/real_rcv1.py --generated [--rows N] [--max-epochs E]
                                            # dry-run the IDENTICAL path on
                                            # data/corpus.py output (no
                                            # network, no BASELINE.md edit)
+
+Checksum manifest (ROADMAP item 5a): every downloaded shard's sha256 is
+verified against ``benches/rcv1_sha256.json``.  Shards the manifest does
+not know yet are recorded trust-on-first-use (and flagged
+``verified: false`` in the output JSON) so the SECOND run — and every
+CI re-run after — fails loudly on a corrupted or truncated re-download
+instead of feeding garbage to the parse gate.  The --generated dry-run
+exercises the same code path against a manifest sidecar in the corpus
+folder.
 
 Stages (each timed, all results in ONE stdout JSON line):
 
@@ -36,6 +52,8 @@ the dry-run prints the section to stderr instead.
 
 from __future__ import annotations
 
+import glob
+import hashlib
 import json
 import os
 import subprocess
@@ -47,10 +65,64 @@ sys.path.insert(0, REPO)
 
 FULL_ROWS = 804_414  # DatasetTests.scala:18
 PARSE_GATE_S = 40.0  # DatasetTests.scala:11-23
+# sha256 manifest for the downloaded LYRL2004 shards (trust-on-first-use:
+# the first egress-enabled run records, every later run verifies)
+MANIFEST = os.path.join(REPO, "benches", "rcv1_sha256.json")
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_checksums(folder: str, manifest_path: str = MANIFEST,
+                     record: bool = True) -> dict:
+    """Verify every corpus shard in `folder` against the sha256 manifest.
+
+    Known shards must match exactly (SystemExit on mismatch — a corrupted
+    or truncated download must never reach the parser); unknown shards
+    are recorded trust-on-first-use when `record` and reported with
+    ``verified: false`` so the output JSON shows which hashes were pinned
+    THIS run rather than checked against history."""
+    shards = sorted(
+        glob.glob(os.path.join(folder, "lyrl2004_*.dat"))
+        + glob.glob(os.path.join(folder, "*.qrels")))
+    manifest = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    out, changed = {}, False
+    for path in shards:
+        name = os.path.basename(path)
+        digest = _sha256(path)
+        if name in manifest:
+            if manifest[name] != digest:
+                raise SystemExit(
+                    f"checksum mismatch for {name}: manifest "
+                    f"{manifest[name][:16]}..., file {digest[:16]}... — "
+                    f"corrupted/truncated download (delete the file and "
+                    f"re-run, or update {manifest_path} if the upstream "
+                    f"corpus legitimately changed)")
+            out[name] = {"sha256": digest, "verified": True}
+        else:
+            manifest[name] = digest
+            changed = True
+            out[name] = {"sha256": digest, "verified": False}
+            log(f"checksum recorded (trust-on-first-use): {name} = "
+                f"{digest[:16]}...")
+    if changed and record:
+        with open(manifest_path, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.write("\n")
+        log(f"manifest updated: {manifest_path}")
+    return out
 
 
 def ensure_files(folder: str, generated: bool, rows: int, seed: int = 0) -> dict:
@@ -79,7 +151,17 @@ def ensure_files(folder: str, generated: bool, rows: int, seed: int = 0) -> dict
             with open(meta_path, "w") as f:
                 json.dump(meta, f)
             log(f"generated corpus: {meta['bytes'] / 1e6:.1f} MB")
-        return {"kind": "generated", "seconds": time.perf_counter() - t0}
+            # a regenerated corpus invalidates any sidecar manifest from a
+            # previous (different-rows) generation
+            sidecar = os.path.join(folder, "corpus_sha256.json")
+            if os.path.exists(sidecar):
+                os.remove(sidecar)
+        # same verify path as the real corpus, against a folder-local
+        # sidecar manifest (first run records, cached reuse verifies)
+        checksums = verify_checksums(
+            folder, manifest_path=os.path.join(folder, "corpus_sha256.json"))
+        return {"kind": "generated", "seconds": time.perf_counter() - t0,
+                "checksums": checksums}
     if not os.path.exists(train_file):
         os.makedirs(folder, exist_ok=True)
         script = os.path.join(REPO, "data", "download.sh")
@@ -92,7 +174,8 @@ def ensure_files(folder: str, generated: bool, rows: int, seed: int = 0) -> dict
 
             shutil.copy(script, target)
         subprocess.run(["bash", target], check=True)
-    return {"kind": "real", "seconds": time.perf_counter() - t0}
+    return {"kind": "real", "seconds": time.perf_counter() - t0,
+            "checksums": verify_checksums(folder)}
 
 
 def parse_stage(folder: str, full_scale: bool) -> tuple:
@@ -158,9 +241,22 @@ def baseline_section(out: dict) -> str:
     )
 
 
+def slice_dataset(data, n: int):
+    """First-`n`-rows view of a parsed Dataset (the --slice fast path:
+    parse runs — and gates — at full scale, the fit/bench stages run on
+    the slice so the first egress-enabled attempt verifies the whole
+    pipeline in minutes instead of hours)."""
+    from distributed_sgd_tpu.data.rcv1 import Dataset
+
+    n = min(int(n), len(data))
+    return Dataset(indices=data.indices[:n], values=data.values[:n],
+                   labels=data.labels[:n], n_features=data.n_features)
+
+
 def main(argv) -> int:
     generated = "--generated" in argv
     rows, max_epochs, folder = FULL_ROWS, 10, os.path.join(REPO, "data")
+    slice_n = None
     for i, a in enumerate(argv):
         if a == "--rows":
             rows = int(argv[i + 1])
@@ -168,6 +264,8 @@ def main(argv) -> int:
             max_epochs = int(argv[i + 1])
         elif a == "--folder":
             folder = argv[i + 1]
+        elif a == "--slice":
+            slice_n = int(argv[i + 1])
     if generated and folder == os.path.join(REPO, "data"):
         folder = "/tmp/rcv1_turnkey"
 
@@ -176,12 +274,17 @@ def main(argv) -> int:
     out["files"] = ensure_files(folder, generated, rows)
     full_scale = not generated
     data, out["parse"] = parse_stage(folder, full_scale)
+    if slice_n is not None:
+        data = slice_dataset(data, slice_n)
+        out["slice"] = len(data)
+        log(f"sliced to the first {len(data)} rows for the fit/bench stages")
     out["scenario"] = scenario_stage(data, max_epochs)
     out["bench"] = bench_stage(data)
 
     section = baseline_section(out)
-    if generated:
-        log("dry-run: BASELINE.md untouched; section would be:")
+    if generated or slice_n is not None:
+        # a sliced epoch time is not the full-scale record either way
+        log("dry-run/slice: BASELINE.md untouched; section would be:")
         log(section)
     else:
         path = os.path.join(REPO, "BASELINE.md")

@@ -115,6 +115,26 @@ class Config:
     # every N successful windows into checkpoint_dir; 0 disables.  A
     # restarted master resumes bit-exactly from the last snapshot.
     fit_ckpt_every: int = 0
+    # -- cluster telemetry plane + training-health monitor (telemetry/) ----
+    # telemetry: the master scrapes every registered worker's instrument
+    # registry over the Metrics RPC (heartbeat-piggybacked + on-demand)
+    # and re-exports the merged series — counters summed, histogram
+    # buckets summed exactly, gauges last-write per worker label — on ONE
+    # cluster-level /metrics endpoint; workers additionally publish the
+    # training-health gauges (gradient norm, dispatch staleness, EF
+    # residual norm).  Off (default): no Metrics RPC is ever issued and
+    # the wire/call graph stay byte-identical (rpc engine only; the mesh
+    # engines are one process — their existing exporter IS cluster-level).
+    telemetry: bool = False
+    # cluster /metrics bind port on the master (0 = OS-assigned)
+    telemetry_port: int = 9091
+    # loss-trend watchdog on rpc sync fits (telemetry/health.py): None
+    # (default) = no health observation at all; warn = log + flight dump
+    # on trip; snapshot = additionally write a resumable fit-state
+    # snapshot (needs DSGD_CHECKPOINT_DIR); halt = snapshot, then stop
+    # the fit — a dying run leaves evidence and a checkpoint instead of
+    # a flat loss curve.
+    health_action: Optional[str] = None
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     # InfluxDB write endpoint for the push reporter (reference parity:
     # Kamon InfluxDBReporter, application.conf:54-78), e.g.
@@ -212,6 +232,18 @@ class Config:
                 "snapshot lives under the checkpoint directory")
         if not 0.0 <= self.trace_sample <= 1.0:
             raise ValueError("trace_sample must be a probability in [0, 1]")
+        if self.telemetry_port < 0:
+            raise ValueError("telemetry_port must be >= 0 (0 = OS-assigned)")
+        if self.health_action not in (None, "warn", "snapshot", "halt"):
+            raise ValueError(
+                f"DSGD_HEALTH_ACTION={self.health_action!r} must be one of "
+                f"warn | snapshot | halt (unset = no health monitor)")
+        if (self.health_action in ("snapshot", "halt")
+                and not self.checkpoint_dir):
+            raise ValueError(
+                f"DSGD_HEALTH_ACTION={self.health_action} needs "
+                f"DSGD_CHECKPOINT_DIR: the resumable trip snapshot lives "
+                f"under the checkpoint directory")
         if self.flight_recorder < 0:
             raise ValueError("flight_recorder must be >= 0 (0 disables)")
         if self.checkpoint_every < 1:
@@ -321,6 +353,9 @@ class Config:
             elastic=_env("DSGD_ELASTIC", cls.elastic, bool),
             async_drain=_env("DSGD_ASYNC_DRAIN", cls.async_drain, bool),
             fit_ckpt_every=_env("DSGD_FIT_CKPT_EVERY", cls.fit_ckpt_every, int),
+            telemetry=_env("DSGD_TELEMETRY", cls.telemetry, bool),
+            telemetry_port=_env("DSGD_TELEMETRY_PORT", cls.telemetry_port, int),
+            health_action=_env("DSGD_HEALTH_ACTION", None, str),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             influx_url=_env("DSGD_INFLUX_URL", None, str),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),

@@ -43,6 +43,7 @@ class DevCluster:
         chaos: Optional[str] = None,
         gossip_topology: str = "all",
         master_watch_s: Optional[float] = None,
+        telemetry_port: Optional[int] = None,
     ):
         # fault injection (chaos/, DSGD_CHAOS): the plan must be installed
         # BEFORE any node opens a channel so every stub is wrapped — but it
@@ -62,16 +63,31 @@ class DevCluster:
         # kept for add_worker (elastic churn: join a fresh worker mid-fit)
         self._host, self._devs, self._seed = host, devs, seed
         self._train, self._model = train, model
+        # cluster telemetry (telemetry/, DSGD_TELEMETRY): per-NODE metric
+        # registries instead of the shared process-global one — in one
+        # process a shared registry would make every worker's Metrics
+        # reply identical and the cluster sum triple-count — plus the
+        # master-side aggregator + endpoint on `telemetry_port`
+        self._telemetry = telemetry_port is not None
+        from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+        def node_metrics():
+            return metrics_mod.Metrics() if self._telemetry else None
+
+        self._node_metrics = node_metrics
         self._worker_kwargs = dict(
             steps_per_dispatch=steps_per_dispatch, compress=compress,
             compress_k=compress_k, compress_ef=compress_ef,
             gossip_topology=gossip_topology, master_watch_s=master_watch_s,
+            telemetry=self._telemetry,
         )
         self.master = MasterNode(
             host, base_port, train, test, model,
-            expected_workers=n_workers, seed=seed,
+            expected_workers=n_workers, seed=seed, metrics=node_metrics(),
         ).start(heartbeat_s=heartbeat_s,
                 heartbeat_max_misses=heartbeat_max_misses)
+        if self._telemetry:
+            self.master.enable_telemetry(telemetry_port)
         if self._chaos_installed:
             from distributed_sgd_tpu import chaos as chaos_mod
 
@@ -82,11 +98,13 @@ class DevCluster:
             w = WorkerNode(
                 host, port, host, self.master.port, train, model,
                 device=devs[i % len(devs)], seed=seed + i,
+                metrics=node_metrics(),
                 steps_per_dispatch=steps_per_dispatch,
                 compress=compress, compress_k=compress_k,
                 compress_ef=compress_ef,
                 gossip_topology=gossip_topology,
                 master_watch_s=master_watch_s,
+                telemetry=self._telemetry,
             )
             self.workers.append(w)
             if self._chaos_installed:
@@ -117,6 +135,7 @@ class DevCluster:
             self._train, self._model,
             device=self._devs[i % len(self._devs)],
             seed=self._seed + i if seed is None else seed,
+            metrics=self._node_metrics(),
             **self._worker_kwargs,
         )
         self.workers.append(w)

@@ -417,6 +417,14 @@ class MasterNode:
         # fit loop kicks these with their current assignment each tick
         self._rereg_pending: set = set()
 
+        # cluster telemetry plane (telemetry/, DSGD_TELEMETRY,
+        # docs/OBSERVABILITY.md): enable_telemetry() installs the scrape
+        # aggregator (+ optional cluster /metrics endpoint); None (default)
+        # means no Metrics RPC is ever issued — knobs-off call graph and
+        # wire stay byte-identical
+        self.telemetry = None
+        self.telemetry_exporter = None
+
         self.server = new_server(port, host="0.0.0.0")
         self.port = self.port or self.server.bound_port
         add_master_servicer(self.server, _MasterServicer(self), node="master")
@@ -456,6 +464,41 @@ class MasterNode:
             self._hb_thread.start()
         return self
 
+    def enable_telemetry(self, port: Optional[int] = None,
+                         scrape_min_age_s: float = 0.5):
+        """Install the cluster telemetry plane (telemetry/aggregate.py):
+        the master scrapes every registered worker's instrument registry
+        over the Metrics RPC — piggybacked on the heartbeat cadence when
+        the heartbeat runs, and refreshed on demand (throttled by
+        `scrape_min_age_s`) whenever the cluster endpoint is pulled — and
+        re-exports the merged series on one `/metrics` endpoint bound to
+        `port` (0 = OS-assigned; None = aggregator only, no endpoint).
+        Returns the ClusterTelemetry so embedders can render directly."""
+        from distributed_sgd_tpu.telemetry.aggregate import (
+            ClusterExporter,
+            ClusterTelemetry,
+        )
+
+        self.telemetry = ClusterTelemetry(self.metrics, node="master",
+                                          role="master")
+        if port is not None:
+            self.telemetry_exporter = ClusterExporter(
+                self.telemetry.prometheus_text, port,
+                refresh=lambda: self.scrape_telemetry(
+                    min_age_s=scrape_min_age_s),
+            ).start()
+            self.log.info("cluster telemetry endpoint on :%d",
+                          self.telemetry_exporter.port)
+        return self.telemetry
+
+    def scrape_telemetry(self, min_age_s: float = 0.0) -> int:
+        """One (throttled) Metrics-RPC scrape over the current members;
+        returns snapshots merged.  Safe from any thread; never raises."""
+        if self.telemetry is None:
+            return 0
+        return self.telemetry.scrape(self._members(), self.rpc_policy,
+                                     min_age_s=min_age_s)
+
     def _heartbeat_loop(self, interval_s: float, max_failures: int = 3) -> None:
         tracker = _FailureTracker(max_failures)
         # probe deadline: the interval, capped by the policy deadline so a
@@ -463,6 +506,14 @@ class MasterNode:
         probe_timeout = min(interval_s, self.rpc_policy.deadline_s)
         while not self._hb_stop.wait(interval_s):
             members = self._members()
+            # telemetry piggyback (docs/OBSERVABILITY.md): the scrape rides
+            # the liveness cadence — concurrent futures bounded by the
+            # probe deadline, breaker-consulting, failures degrade to
+            # counters — so a dead worker can delay but never stall the
+            # eviction probes below
+            if self.telemetry is not None:
+                self.telemetry.scrape(members, self.rpc_policy,
+                                      deadline_s=probe_timeout)
             # probe concurrently so one dead worker costs one timeout, not D
             futs = []
             for key, stub in members:
@@ -489,6 +540,8 @@ class MasterNode:
         self._hb_stop.set()
         self._async_running.clear()
         self._async_done.set()
+        if self.telemetry_exporter is not None:
+            self.telemetry_exporter.stop()
         self.server.stop(grace=1.0)
         for ch in self._channels.values():
             ch.close()
@@ -580,6 +633,11 @@ class MasterNode:
         if evicted:
             flight.record("worker.evicted", worker=f"{host}:{port}")
             flight.dump("eviction")
+        if self.telemetry is not None:
+            # a departed worker's series leave the cluster exposition with
+            # its membership (its final snapshot would otherwise pin stale
+            # gauges forever)
+            self.telemetry.drop(key)
         with self._members_lock:
             self._workers.pop(key, None)
             ch = self._channels.pop(key, None)
@@ -829,6 +887,7 @@ class MasterNode:
         hedge: bool = True,
         fit_state_path: Optional[str] = None,
         fit_state_every: int = 0,
+        health=None,
     ) -> FitResult:
         """Fault-tolerant sync fit, with an optional pipelined wire path.
 
@@ -905,6 +964,17 @@ class MasterNode:
         step count (tests/test_elastic.py).  `fit_state_every=0`
         (default) disables snapshots; snapshotting is pure observation
         (enabled-but-uninterrupted runs land on bit-identical weights).
+
+        Training-health monitor (`health`, a telemetry.HealthMonitor;
+        DSGD_HEALTH_ACTION, docs/OBSERVABILITY.md): per-round gradient-
+        norm/staleness gauges plus a loss-trend watchdog.  A non-finite
+        fan-in gradient trips BEFORE the poisoned update is applied; an
+        EWMA loss divergence trips at the epoch eval.  On trip the
+        monitor dumps the flight recorder, and per its action the fit
+        additionally writes a resumable fit-state snapshot to
+        `fit_state_path` ('snapshot') and/or stops ('halt') — a dying
+        fit leaves evidence and a checkpoint instead of a flat loss
+        curve.  None (default) runs no health observation at all.
         """
         if on_worker_death not in ("resplit", "fail"):
             raise ValueError(f"on_worker_death must be resplit|fail, got {on_worker_death!r}")
@@ -946,6 +1016,14 @@ class MasterNode:
         # the worker rolls back its EF residual drain for the skipped round
         ef_rollback: Dict[Tuple[str, int], int] = {}
         stalled = self.metrics.counter(metrics_mod.SYNC_STALLED)
+        # training-health monitor (telemetry/health.py): inert when None
+        if (health is not None and health.action != "warn"
+                and not fit_state_path):
+            self.log.warning(
+                "health action %r has no fit-state path (set "
+                "DSGD_CHECKPOINT_DIR): a trip will leave flight evidence "
+                "but no resumable snapshot", health.action)
+        halted = False
 
         from distributed_sgd_tpu.checkpoint import opt_kind_tag
         from distributed_sgd_tpu.parallel.sync import resolve_optimizer
@@ -1029,6 +1107,23 @@ class MasterNode:
             result.epochs_run = start_epoch
             result.state = GradState(weights=w, loss=loss).finish()
             return result
+
+        def _health_snapshot(epoch_, batch_, rng_state_, w_):
+            """Resumable fit-state snapshot at the exact loop state a
+            health trip interrupted (actions 'snapshot'/'halt'); no-op
+            without a fit_state_path (warned above)."""
+            if not fit_state_path:
+                return
+            save_fit_state(
+                fit_state_path, weights=w_, epoch=epoch_, batch=batch_,
+                rng_state=rng_state_, test_losses_nf=test_newest_first,
+                opt_kind=opt_kind,
+                opt_leaves=jax.tree_util.tree_leaves(opt_state)
+                if opt_state is not None else [],
+                bcast_version=bcast.version, fit_tokens=fit_tokens)
+            self.log.warning(
+                "health watchdog wrote a resumable fit-state snapshot to "
+                "%s", fit_state_path)
 
         rounds_since_save = 0
         stopped_early = False
@@ -1183,6 +1278,31 @@ class MasterNode:
                     for reply in replies:
                         codec.decode_grad_into(reply, grad_acc)
                     grad_acc /= len(replies)  # true divide, bit-matching np.mean
+                    if health is not None:
+                        # NaN/Inf sentinel: a non-finite fan-in NEVER
+                        # reaches the weights, whatever the action — the
+                        # snapshot carries the last GOOD state, cursor
+                        # pointing at this window
+                        if health.observe_round(
+                                float(np.linalg.norm(grad_acc)),
+                                staleness_s=time.perf_counter() - t_batch):
+                            wspan.set(health_tripped=True)
+                            if health.action in ("snapshot", "halt"):
+                                _health_snapshot(
+                                    epoch, batch, rng.bit_generator.state, w)
+                            if health.action == "halt":
+                                halted = True
+                                break
+                            # warn/snapshot: drop the poisoned round and
+                            # continue on the last finite weights (the
+                            # verdict is NOT latched — every later
+                            # non-finite round is dropped too)
+                            self.log.error(
+                                "dropping non-finite fan-in at epoch %d "
+                                "window %d (health action %s)",
+                                epoch, int(batch), health.action)
+                            batch += window_span
+                            continue
                     w_old = w
                     if local_steps > 1:
                         # replies are summed weight-space decrements; apply the
@@ -1221,6 +1341,12 @@ class MasterNode:
                             bcast_version=bcast.version,
                             fit_tokens=fit_tokens)
                         rounds_since_save = 0
+            if halted:
+                self.log.error(
+                    "fit halted by the training-health watchdog (%s) at "
+                    "epoch %d window %d", health.trip_reason, epoch,
+                    int(batch))
+                break
             epoch_s = time.perf_counter() - t0
 
             loss, acc = self.local_loss(w)
@@ -1234,6 +1360,22 @@ class MasterNode:
                 "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
                 epoch, loss, acc, test_loss, test_acc, epoch_s,
             )
+            if health is not None and health.observe_loss(loss):
+                # loss-trend watchdog (EWMA divergence / non-finite loss):
+                # the monitor already dumped the flight ring; snapshot at
+                # the epoch boundary (next epoch's cursor, fresh per-epoch
+                # stream — the same shape as the terminal snapshot below)
+                if health.action in ("snapshot", "halt"):
+                    _health_snapshot(
+                        epoch + 1, 0,
+                        np.random.default_rng(
+                            (self.seed, epoch + 1)).bit_generator.state, w)
+                if health.action == "halt":
+                    self.log.error(
+                        "fit halted by the training-health watchdog (%s) "
+                        "after epoch %d", health.trip_reason, epoch)
+                    halted = True
+                    break
             if checkpointer is not None and (epoch + 1) % checkpoint_every == 0:
                 save_sync_fit(
                     checkpointer, epoch + 1, w, test_newest_first, opt_kind,
@@ -1248,8 +1390,15 @@ class MasterNode:
             checkpointer, result.epochs_run, start_epoch, checkpoint_every,
             w, test_newest_first, opt_kind,
             jax.tree_util.tree_leaves(opt_state) if opt_state is not None else [])
-        if fit_state_path and fit_state_every:
-            # terminal snapshot: finished marks a CONVERGED fit (criterion
+        if fit_state_path and (fit_state_every or health is not None) \
+                and not halted:
+            # terminal snapshot (skipped on a health halt: the watchdog's
+            # own snapshot carries the exact interrupted cursor, which a
+            # coarser end-of-fit write would roll back).  A health-enabled
+            # run writes it even with fit_state_every=0, so a COMPLETED
+            # resume overwrites the stale trip snapshot instead of leaving
+            # it to be re-restored by every later run.  finished marks a
+            # CONVERGED fit (criterion
             # break at epochs_run < max_epochs) so a restart takes the
             # nothing-to-run path instead of training past convergence —
             # the epoch cursor alone cannot say this.  Budget exhaustion
@@ -1894,6 +2043,11 @@ class MasterNode:
                         metrics_mod.ASYNC_DRAIN_FALLBACK).increment()
                 return False
             self._inbox.append((delta, n_steps))
+            # health gauge (telemetry/health.py): inbox depth is the
+            # arrival-vs-drain pressure signal the alert rules watch; a
+            # GIL-atomic float set under the lock we already hold
+            self.metrics.gauge(
+                metrics_mod.HEALTH_DRAIN_BACKLOG).set(len(self._inbox))
             self._inbox_cv.notify()
             return True
 
@@ -1910,6 +2064,7 @@ class MasterNode:
                 while not self._inbox and self._drain_on:
                     self._inbox_cv.wait(timeout=0.25)
                 batch, self._inbox = self._inbox, []
+                self.metrics.gauge(metrics_mod.HEALTH_DRAIN_BACKLOG).set(0)
                 if not batch and not self._drain_on:
                     return
             if not batch:

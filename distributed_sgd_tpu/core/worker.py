@@ -77,6 +77,7 @@ class WorkerNode:
         gossip_topology: str = "all",
         master_watch_s: Optional[float] = None,
         master_watch_misses: int = 3,
+        telemetry: bool = False,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -144,6 +145,15 @@ class WorkerNode:
         # (default) keeps the one-shot registration of the reference.
         self._master_watch_s = master_watch_s
         self._master_watch_misses = max(1, int(master_watch_misses))
+        # cluster telemetry plane (telemetry/, DSGD_TELEMETRY,
+        # docs/OBSERVABILITY.md): when on, each gradient dispatch publishes
+        # the training-health gauges (gradient norm, dispatch staleness,
+        # EF residual norm) that the master's Metrics-RPC scrape
+        # re-exports per worker.  Off (default) the dispatch path runs no
+        # extra host work at all; the Metrics RPC itself is always served
+        # (pull-only — it costs nothing until somebody scrapes).
+        self.telemetry = bool(telemetry)
+        self._last_dispatch_t: Optional[float] = None
 
         # device-resident copy of the full dataset (the reference slave also
         # holds the full data and receives sample indices, Main.scala:138)
@@ -533,6 +543,30 @@ class WorkerNode:
                 )
             return self._compressor.compress(g, dest="sync:master")
 
+    def record_health(self, g: np.ndarray) -> None:
+        """Per-dispatch training-health gauges (telemetry/health.py,
+        DSGD_TELEMETRY): this node's gradient norm, the gap since its
+        previous dispatch (update staleness as the worker sees it), and
+        the error-feedback residual norm when compression is on.  Called
+        only with ``self.telemetry`` set, so the knobs-off dispatch path
+        pays nothing."""
+        now = time.monotonic()
+        prev, self._last_dispatch_t = self._last_dispatch_t, now
+        m = self.metrics
+        m.gauge(metrics_mod.HEALTH_GRAD_NORM).set(float(np.linalg.norm(g)))
+        if prev is not None:
+            m.gauge(metrics_mod.HEALTH_STALENESS).set(now - prev)
+        if self._compressor is not None:
+            # the residual destination depends on the engine: sync replies
+            # drain "sync:master", the async gossip loop drains "master" —
+            # report whichever this worker is actually accumulating
+            res = self._compressor.residual_snapshot("sync:master")
+            if res is None:
+                res = self._compressor.residual_snapshot("master")
+            if res is not None:
+                m.gauge(metrics_mod.HEALTH_EF_RESIDUAL_NORM).set(
+                    float(np.linalg.norm(res)))
+
     def rollback_sync_ef(self, version: int) -> None:
         """Quorum contribution mask (GradientRequest.ef_rollback_version):
         the master discarded this worker's reply for broadcast `version`
@@ -688,6 +722,10 @@ class WorkerNode:
                 self._w = self._apply(self._w, delta)
             self.metrics.counter("slave.async.batch").increment(ksteps)
             delta_np = np.asarray(delta)
+            if self.telemetry:
+                # async dispatches publish the same health gauges as sync
+                # Gradient bodies: the delta IS this node's update signal
+                self.record_health(delta_np)
             # gossip fan-out span (trace/, one local trace per dispatch,
             # head-sampled): encode + hand-off per destination — the sends
             # themselves are fire-and-forget futures
@@ -826,12 +864,18 @@ class _WorkerServicer:
             # uncompressed and leave this worker's OWN sync EF residual
             # untouched — the residual for that slice belongs to the
             # straggler, and draining ours here would double-count mass
-            # against the master's average
+            # against the master's average.  The health gauges are
+            # likewise NOT recorded: the gradient norm belongs to the
+            # straggler's slice, and overwriting this node's per-worker
+            # series with it would pollute the dashboards exactly when
+            # the cluster is under straggler stress
             self.w.metrics.counter("slave.sync.hedge").increment()
             msg = codec.encode_grad(g)
             if k > 1:
                 msg.n_steps = k
             return msg
+        if self.w.telemetry:
+            self.w.record_health(g)
         # sync fan-in reply: compressed when configured (EF residual keyed
         # to the one sync destination — this worker answers one master),
         # with the retry-rollback + fit-session guards of encode_sync_grad
@@ -869,3 +913,12 @@ class _WorkerServicer:
     def UpdateGrad(self, request, context):  # noqa: N802
         self.w.apply_delta(codec.decode_grad(request))
         return pb.Ack()
+
+    def Metrics(self, request, context):  # noqa: N802
+        # cluster telemetry scrape (telemetry/aggregate.py): pull-only —
+        # serving the snapshot costs nothing until a master scrapes, so
+        # the method needs no knob
+        from distributed_sgd_tpu.telemetry.aggregate import snapshot_metrics
+
+        return snapshot_metrics(self.w.metrics, role="worker",
+                                node=self.w.node_label)

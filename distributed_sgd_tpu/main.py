@@ -184,6 +184,14 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             "DSGD_GOSSIP_TOPOLOGY=%s ignored: only the gossip engines "
             "(async_mode=gossip or engine=rpc async) have a peer fan-out",
             cfg.gossip_topology)
+    if cfg.telemetry or cfg.health_action:
+        # the telemetry plane scrapes over the Metrics RPC and the health
+        # monitor rides fit_sync's fan-in; a one-process mesh engine has
+        # neither (its existing /metrics exporter IS the cluster view)
+        log.warning(
+            "DSGD_TELEMETRY/DSGD_HEALTH_ACTION ignored: the cluster "
+            "telemetry plane is the rpc topology's (use engine=rpc; "
+            "docs/OBSERVABILITY.md)")
     log.info(
         "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
         n, virtual, cfg.kernel, cfg.model, cfg.use_async,
@@ -255,13 +263,28 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
 
 def _fit_state_args(cfg: Config) -> dict:
     """DSGD_FIT_CKPT_EVERY -> fit_sync crash-snapshot kwargs (empty when
-    disabled; config validation already required checkpoint_dir)."""
-    if not cfg.fit_ckpt_every or not cfg.checkpoint_dir:
+    disabled; config validation already required checkpoint_dir).  ANY
+    health action also gets the path (with fit_ckpt_every=0 it is the
+    path alone, so no cadence snapshots run): 'snapshot'/'halt' write the
+    trip snapshot there, and every action — 'warn' included — RESTORES
+    one a previous halted run left, so restarting after a halt resumes
+    regardless of which action the restart runs with."""
+    if not (cfg.fit_ckpt_every or cfg.health_action) or not cfg.checkpoint_dir:
         return {}
     from distributed_sgd_tpu.checkpoint import fit_state_path
 
     return {"fit_state_path": fit_state_path(cfg.checkpoint_dir),
             "fit_state_every": cfg.fit_ckpt_every}
+
+
+def _health_monitor(cfg: Config, metrics=None):
+    """DSGD_HEALTH_ACTION -> telemetry.HealthMonitor (None when unset)."""
+    if not cfg.health_action:
+        return None
+    from distributed_sgd_tpu.telemetry.health import HealthMonitor
+
+    log.info("training-health monitor on: action=%s", cfg.health_action)
+    return HealthMonitor(metrics=metrics, action=cfg.health_action)
 
 
 def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
@@ -275,7 +298,9 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                     steps_per_dispatch=cfg.steps_per_dispatch,
                     compress=cfg.compress, compress_k=cfg.compress_k,
                     compress_ef=cfg.compress_ef, chaos=cfg.chaos,
-                    gossip_topology=cfg.gossip_topology) as c:
+                    gossip_topology=cfg.gossip_topology,
+                    telemetry_port=cfg.telemetry_port if cfg.telemetry
+                    else None) as c:
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
@@ -296,6 +321,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
                 quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
+                health=_health_monitor(cfg, metrics=c.master.metrics),
                 **_fit_state_args(cfg),
             )
         _finish(cfg, res, evaluator=lambda w: c.master.local_loss(w, test=True),
@@ -438,6 +464,10 @@ def _run_role(cfg: Config, role: str) -> None:
             expected_workers=cfg.node_count, seed=cfg.seed,
         ).start(heartbeat_s=cfg.heartbeat_s,
                 heartbeat_max_misses=cfg.heartbeat_max_misses)
+        if cfg.telemetry:
+            # cluster telemetry plane (telemetry/): scrape aggregator +
+            # the ONE cluster-level /metrics endpoint
+            master.enable_telemetry(cfg.telemetry_port)
         criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
         master.await_ready()
         ckpt = _make_checkpointer(cfg)
@@ -457,6 +487,7 @@ def _run_role(cfg: Config, role: str) -> None:
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
                 quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
+                health=_health_monitor(cfg, metrics=master.metrics),
                 **_fit_state_args(cfg),
             )
         _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True),
@@ -480,6 +511,9 @@ def _run_role(cfg: Config, role: str) -> None:
             # probes Master.Ping and re-enters the jittered registration
             # loop on sustained loss (docs/ELASTICITY.md)
             master_watch_s=(cfg.heartbeat_s or 5.0) if cfg.elastic else None,
+            # cluster telemetry: publish the per-dispatch health gauges
+            # the master's Metrics-RPC scrape re-exports per worker
+            telemetry=cfg.telemetry,
         ).start()
         worker.await_termination()
 

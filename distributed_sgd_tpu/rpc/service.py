@@ -255,6 +255,11 @@ _WORKER_METHODS = {
     "StartAsync": (pb.StartAsyncRequest, pb.Ack),
     "StopAsync": (pb.Empty, pb.Ack),
     "UpdateGrad": (pb.GradUpdate, pb.Ack),
+    # cluster telemetry scrape (telemetry/, docs/OBSERVABILITY.md): the
+    # master pulls this node's full instrument registry; an older binary
+    # without the method answers UNIMPLEMENTED, which the scraper treats
+    # as a degraded-but-non-fatal miss
+    "Metrics": (pb.Empty, pb.MetricsSnapshot),
 }
 
 # The inference front end (serving/): no reference counterpart — the
@@ -262,7 +267,13 @@ _WORKER_METHODS = {
 _SERVE_METHODS = {
     "Predict": (pb.PredictRequest, pb.PredictReply),
     "ServeHealth": (pb.Empty, pb.ServeHealthReply),
+    "Metrics": (pb.Empty, pb.MetricsSnapshot),
 }
+
+# Methods a servicer may legitimately lack (older binaries, partial test
+# stubs): absent -> no handler -> UNIMPLEMENTED to callers.  Everything
+# else is required and fails server construction when missing.
+_OPTIONAL_METHODS = frozenset({"Metrics"})
 
 
 def _traced_handler(fn, method: str, node: Optional[str]):
@@ -291,6 +302,14 @@ def _add_servicer(server, servicer, service_name: str, methods: dict,
                   node: Optional[str] = None) -> None:
     handlers = {}
     for name, (req, resp) in methods.items():
+        if name in _OPTIONAL_METHODS and not hasattr(servicer, name):
+            # version-skew tolerance for the OPTIONAL surface only: a
+            # servicer that predates it registers no handler and callers
+            # get the standard UNIMPLEMENTED.  Required methods keep the
+            # loud build-time AttributeError below — a typo'd core
+            # handler must not become a mid-fit UNIMPLEMENTED the
+            # retry/eviction machinery misreads as a dead peer.
+            continue
         fn = _traced_handler(getattr(servicer, name), name, node)
         handlers[name] = grpc.unary_unary_rpc_method_handler(
             fn, request_deserializer=req.FromString, response_serializer=resp.SerializeToString

@@ -23,6 +23,7 @@ Wired into main.py as the `DSGD_ROLE=serve` role; knobs in config.py
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import List, Optional, Sequence, Tuple
 
@@ -130,11 +131,15 @@ class ServingServicer:
     """dsgd.Serving method implementations (rpc/service.py _SERVE_METHODS)."""
 
     def __init__(self, store: ModelStore, batcher: MicroBatcher,
-                 metrics=None, request_timeout_s: float = 30.0):
+                 metrics=None, request_timeout_s: float = 30.0,
+                 node: Optional[str] = None):
         self._store = store
         self._batcher = batcher
         self._metrics = metrics
         self._timeout = float(request_timeout_s)
+        # stable identity for the telemetry scrape: replicas must not
+        # collide on one worker label when an aggregator folds a fleet
+        self._node = node or f"serve:{os.getpid()}"
 
     def Predict(self, request, context):  # noqa: N802 - gRPC method name
         t0 = time.perf_counter()
@@ -193,6 +198,18 @@ class ServingServicer:
             queue_depth=self._batcher.depth,
         )
 
+    def Metrics(self, request, context):  # noqa: N802 - gRPC method name
+        # cluster telemetry scrape (telemetry/aggregate.py): lets an
+        # aggregator fold serving replicas into the one cluster view —
+        # each replica under its OWN worker label (colliding labels would
+        # make the merged exposition invalid); pull-only, no knob needed
+        from distributed_sgd_tpu.telemetry.aggregate import snapshot_metrics
+        from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+        return snapshot_metrics(
+            self._metrics or metrics_mod.global_metrics(),
+            role="serve", node=self._node)
+
 
 class ServingServer:
     """Owns the store + engine + batcher + gRPC server lifecycle."""
@@ -228,7 +245,8 @@ class ServingServer:
         self._server = new_server(port, host=host)
         add_serve_servicer(self._server, ServingServicer(
             self.store, self.batcher, metrics=metrics,
-            request_timeout_s=request_timeout_s),
+            request_timeout_s=request_timeout_s,
+            node=f"serve:{self._server.bound_port}"),
             node=f"serve:{self._server.bound_port}")
 
     @classmethod

@@ -1,0 +1,37 @@
+"""Cluster telemetry plane (docs/OBSERVABILITY.md, ISSUE 7).
+
+PR 5's tracing answers "show me round N end to end" and utils/metrics.py
+gives every process its own exporter — but nobody sees the cluster as ONE
+system, and the signals that predict a dying run (gradient norms, EF
+residual growth, update staleness, loss divergence) were not measured at
+all.  This package closes both gaps:
+
+- ``aggregate``: the master scrapes every registered worker's full
+  instrument registry over the new ``dsgd.Worker.Metrics`` RPC
+  (heartbeat-piggybacked + on-demand at scrape time, breaker-consulting
+  but never breaker-feeding) and re-exports the merged series on one
+  cluster-level ``/metrics`` endpoint with ``worker``/``role`` labels —
+  counters SUM, histogram buckets SUM exactly, gauges last-write per
+  label.
+- ``health``: the training-health monitor — per-round gradient-norm /
+  staleness / EF-residual / drain-backlog gauges plus a loss-trend
+  watchdog (EWMA divergence + NaN/Inf sentinel) that, on trip, leaves
+  flight-recorder evidence, attaches a trace event, and (per
+  ``DSGD_HEALTH_ACTION``) snapshots resumable fit state before
+  optionally halting the fit.
+- ``provision``: the generator for the committed Grafana dashboard and
+  Prometheus alert rules under ``kube/observability/`` — dashboards and
+  alerts are DERIVED from the instrument-name constants, and
+  tests/test_observability.py fails the build when they drift.
+
+Everything is default-off: with ``DSGD_TELEMETRY`` unset no Metrics RPC
+is ever issued and the wire stays byte-identical (tests/test_telemetry.py
+asserts both).
+"""
+
+from distributed_sgd_tpu.telemetry.aggregate import (  # noqa: F401
+    ClusterExporter,
+    ClusterTelemetry,
+    snapshot_metrics,
+)
+from distributed_sgd_tpu.telemetry.health import HealthMonitor  # noqa: F401

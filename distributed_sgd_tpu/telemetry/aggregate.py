@@ -1,0 +1,247 @@
+"""Master-aggregated cluster metrics (docs/OBSERVABILITY.md).
+
+``snapshot_metrics`` serializes one node's full instrument registry into
+the ``MetricsSnapshot`` proto (served by the ``Metrics`` RPC on the
+Worker and Serving services); ``ClusterTelemetry`` holds the latest
+snapshot per worker on the master and renders ONE cluster-level
+Prometheus exposition; ``ClusterExporter`` is the HTTP endpoint, which
+refreshes the scrape on demand so a Prometheus pull always sees data no
+older than its own period.
+
+Merge semantics (tested in tests/test_telemetry.py):
+
+- **counters SUM** across nodes into a ``role="cluster"`` series.
+  Snapshots are cumulative and REPLACE the previous snapshot per worker,
+  so scraping twice equals scraping once — a faster scrape cadence can
+  never inflate a counter.
+- **histogram buckets SUM**: bucket counts index the fixed shared bounds
+  (utils/metrics.py ``Histogram.BUCKET_BOUNDS``), so cross-worker sums
+  are exact, and the cluster ``<name>_hist_bucket`` family supports
+  server-side ``histogram_quantile``.  Reservoir quantiles deliberately
+  do NOT cross the wire: subsampled quantiles do not merge; buckets do.
+- **gauges last-write per label**: a gauge is an instantaneous per-node
+  value (gradient norm, staleness) — it appears per ``worker`` label and
+  is never aggregated.
+
+Scrapes consult the per-peer circuit breakers READ-ONLY (a tripped
+training peer is not scraped — one line of degradation instead of a
+blocking failed call) and never FEED them: a flaky metrics reply must
+not open the breaker the training RPCs depend on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import grpc
+
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+from distributed_sgd_tpu.utils.metrics import (
+    Histogram,
+    Metrics,
+    PrometheusExporter,
+    _prom_escape,
+    prom_name as _mangle,
+)
+
+
+def snapshot_metrics(metrics: Metrics, role: str, node: str) -> "pb.MetricsSnapshot":
+    """Serialize one registry into the wire snapshot (cheap: one pass over
+    the instrument lists, no locks held across the encode)."""
+    snap = pb.MetricsSnapshot(role=role, node=node)
+    for c in metrics.counters():
+        snap.counters.add(name=c.name, value=c.value)
+    for g in metrics.gauges():
+        if g.value == g.value:  # never-set gauges (NaN) stay off the wire
+            snap.gauges.add(name=g.name, value=g.value)
+    for h in metrics.histograms():
+        if not h.count:
+            continue
+        hm = snap.hists.add(name=h.name, count=h.count, sum=h.sum,
+                            min=h.min, max=h.max, last=h.last)
+        hm.buckets.extend(h.bucket_counts())
+    return snap
+
+
+def _labels(snap) -> str:
+    return (f'role="{_prom_escape(snap.role)}",'
+            f'worker="{_prom_escape(snap.node)}"')
+
+
+def cluster_prometheus_text(snaps: List["pb.MetricsSnapshot"]) -> str:
+    """Render the merged cluster exposition from per-node snapshots.
+
+    Per family: one ``# TYPE`` line, the per-node samples (labeled
+    ``role``/``worker``), then — for counters and histograms — the
+    cluster aggregate labeled ``role="cluster"``.  Histogram ``le``
+    buckets are emitted at the CLUSTER level only (exact sums over the
+    shared bounds); per node the cheap scalars (_count/_sum/_min/_max/
+    _last) carry the node-local view.  Deterministic ordering: families
+    sorted by name, samples by node label.
+    """
+    snaps = sorted(snaps, key=lambda s: (s.role, s.node))
+    lines: List[str] = []
+
+    gauges: Dict[str, List[Tuple[str, float]]] = {}
+    counters: Dict[str, List[Tuple[str, int]]] = {}
+    hists: Dict[str, List[Tuple[str, "pb.MetricHistogram"]]] = {}
+    for s in snaps:
+        lab = _labels(s)
+        for g in s.gauges:
+            gauges.setdefault(g.name, []).append((lab, g.value))
+        for c in s.counters:
+            counters.setdefault(c.name, []).append((lab, c.value))
+        for h in s.hists:
+            hists.setdefault(h.name, []).append((lab, h))
+
+    for name in sorted(gauges):
+        base = _mangle(name)
+        lines.append(f"# TYPE {base} gauge")
+        for lab, v in gauges[name]:
+            lines.append(f"{base}{{{lab}}} {v}")
+
+    for name in sorted(counters):
+        base = _mangle(name)
+        lines.append(f"# TYPE {base}_total counter")
+        for lab, v in counters[name]:
+            lines.append(f"{base}_total{{{lab}}} {v}")
+        total = sum(v for _, v in counters[name])
+        lines.append(f'{base}_total{{role="cluster"}} {total}')
+
+    n_bounds = len(Histogram.BUCKET_BOUNDS)
+    for name in sorted(hists):
+        base = _mangle(name)
+        per_node = hists[name]
+        for lab, h in per_node:
+            lines.append(f"{base}_count{{{lab}}} {h.count}")
+            lines.append(f"{base}_sum{{{lab}}} {h.sum}")
+            lines.append(f"{base}_min{{{lab}}} {h.min}")
+            lines.append(f"{base}_max{{{lab}}} {h.max}")
+            lines.append(f"{base}_last{{{lab}}} {h.last}")
+        # cluster merge: counts/sums SUM, min/max fold, buckets SUM exactly
+        count = sum(h.count for _, h in per_node)
+        total = sum(h.sum for _, h in per_node)
+        lo = min(h.min for _, h in per_node)
+        hi = max(h.max for _, h in per_node)
+        merged = [0] * n_bounds
+        for _, h in per_node:
+            for i, b in enumerate(h.buckets[:n_bounds]):
+                merged[i] += b
+        lines.append(f'{base}_count{{role="cluster"}} {count}')
+        lines.append(f'{base}_sum{{role="cluster"}} {total}')
+        lines.append(f'{base}_min{{role="cluster"}} {lo}')
+        lines.append(f'{base}_max{{role="cluster"}} {hi}')
+        lines.append(f"# TYPE {base}_hist histogram")
+        cum = 0
+        for le, n in zip(Histogram.BUCKET_BOUNDS, merged):
+            cum += n
+            lines.append(
+                f'{base}_hist_bucket{{role="cluster",le="{le:.9g}"}} {cum}')
+        lines.append(f'{base}_hist_bucket{{role="cluster",le="+Inf"}} {count}')
+        lines.append(f'{base}_hist_sum{{role="cluster"}} {total}')
+        lines.append(f'{base}_hist_count{{role="cluster"}} {count}')
+    return "\n".join(lines) + "\n"
+
+
+class ClusterTelemetry:
+    """Latest-snapshot-per-worker store + scrape fan-out on the master.
+
+    ``scrape(members, rpc_policy)`` issues concurrent ``Metrics`` futures
+    to every member whose breaker is not suppressing calls, waits at most
+    one RPC deadline, and replaces each worker's stored snapshot with the
+    reply.  Failures degrade: they are counted under
+    ``master.telemetry.scrape.errors`` and the dead worker's LAST
+    snapshot stays visible until membership drops it
+    (``unregister_worker`` -> :meth:`drop`).  ``min_age_s`` throttles
+    concurrent refresh triggers (heartbeat piggyback + endpoint pulls).
+    """
+
+    def __init__(self, metrics: Metrics, node: str = "master",
+                 role: str = "master"):
+        self.metrics = metrics  # the master's own registry (also scraped-in)
+        self.node = node
+        self.role = role
+        self._snaps: Dict[Tuple[str, int], "pb.MetricsSnapshot"] = {}
+        self._lock = threading.Lock()
+        self._last_scrape = -float("inf")
+
+    def observe(self, key, snap: "pb.MetricsSnapshot") -> None:
+        """Replace `key`'s snapshot (counters are cumulative: replacement —
+        not accumulation — is what makes repeated scrapes idempotent)."""
+        with self._lock:
+            self._snaps[key] = snap
+            self.metrics.gauge(metrics_mod.TELEMETRY_WORKERS).set(
+                len(self._snaps))
+
+    def drop(self, key) -> None:
+        """Membership removed the worker: its series leave the exposition."""
+        with self._lock:
+            self._snaps.pop(key, None)
+            self.metrics.gauge(metrics_mod.TELEMETRY_WORKERS).set(
+                len(self._snaps))
+
+    def scrape(self, members, rpc_policy, deadline_s: Optional[float] = None,
+               min_age_s: float = 0.0) -> int:
+        """One scrape fan-out over [(key, stub)]; returns snapshots merged.
+        Never raises and never blocks past the RPC deadline — a dead or
+        wedged worker costs one deadline shared across the concurrent
+        futures, not one per worker."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_scrape < min_age_s:
+                return 0
+            self._last_scrape = now
+        deadline = deadline_s if deadline_s is not None else rpc_policy.deadline_s
+        errors = self.metrics.counter(metrics_mod.TELEMETRY_SCRAPE_ERRORS)
+        futs = []
+        for key, stub in members:
+            # read-only breaker consult (CircuitBreaker.suppressed): skip a
+            # tripped peer without consuming its half-open probe slot, and
+            # never report scrape outcomes back — the breaker belongs to
+            # the training RPCs
+            if rpc_policy.breaker(key).suppressed():
+                self.metrics.counter(
+                    metrics_mod.TELEMETRY_SCRAPE_SKIPPED).increment()
+                continue
+            try:
+                futs.append((key, stub.Metrics.future(pb.Empty(),
+                                                      timeout=deadline)))
+            except (ValueError, AttributeError):  # channel closed under us
+                errors.increment()
+        got = 0
+        for key, fut in futs:
+            try:
+                self.observe(key, fut.result())
+                got += 1
+            except grpc.RpcError:
+                # includes UNIMPLEMENTED from an older worker: degraded,
+                # never fatal, never fed to the breaker
+                errors.increment()
+        self.metrics.counter(metrics_mod.TELEMETRY_SCRAPES).increment()
+        return got
+
+    def prometheus_text(self) -> str:
+        """The cluster exposition: every stored worker snapshot plus a
+        fresh snapshot of the master's own registry."""
+        with self._lock:
+            snaps = list(self._snaps.values())
+        snaps.append(snapshot_metrics(self.metrics, self.role, self.node))
+        return cluster_prometheus_text(snaps)
+
+
+class ClusterExporter(PrometheusExporter):
+    """HTTP endpoint for the cluster exposition (one per master): the
+    shared PrometheusExporter plumbing (routing, headers, threading) with
+    a custom `render` and a `refresh` hook — each GET first runs the
+    master's throttled scrape, so a Prometheus pull is never staler than
+    the scrape throttle even when the heartbeat (the other scrape
+    trigger) is off."""
+
+    def __init__(self, render: Callable[[], str], port: int,
+                 host: str = "0.0.0.0",
+                 refresh: Optional[Callable[[], None]] = None):
+        super().__init__(None, port, host=host, render=render,
+                         refresh=refresh)

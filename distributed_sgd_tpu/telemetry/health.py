@@ -1,0 +1,140 @@
+"""Training-health monitor (docs/OBSERVABILITY.md, ISSUE 7).
+
+The aggregate metrics say how fast a run is going; this module says
+whether it is DYING.  A :class:`HealthMonitor` instance rides one
+``fit_sync`` (core/master.py) and watches the two signal classes that
+precede a flat loss curve:
+
+- **per-round signals** (``observe_round``): the fan-in gradient norm
+  and the round's reply staleness, published as gauges so the cluster
+  telemetry plane re-exports them per node.  A non-finite gradient norm
+  is the NaN/Inf sentinel — it trips immediately, before the poisoned
+  update can be applied.
+- **loss trend** (``observe_loss``, once per epoch eval): an EWMA of the
+  raw loss.  The watchdog trips when the EWMA exceeds
+  ``divergence_ratio`` x its best-so-far value for ``patience``
+  consecutive checks (after ``warmup`` observations — the first epochs
+  legitimately move fast), or immediately on a non-finite loss.
+
+On trip the monitor leaves evidence — a flight-recorder event + dump
+(``flight-*-health.json``) and a trace instant event when a trace is
+active — and latches: one dump per fit, no repeated I/O from a run that
+keeps diverging.  What happens NEXT is ``action`` (``DSGD_HEALTH_ACTION``):
+
+- ``warn`` (default): log loudly, keep training (pure observation);
+- ``snapshot``: additionally write a resumable fit-state snapshot via
+  PR 6's ``save_fit_state`` (the caller owns the path), keep training;
+- ``halt``: snapshot, then stop the fit — a dying run ends with evidence
+  and a resumable checkpoint instead of a flat loss curve.
+
+The monitor itself never writes the snapshot (it has no access to the fit
+loop's cursor/RNG state); ``fit_sync`` reads ``action``/``tripped`` and
+does the snapshotting at the exact loop state the trip interrupted.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+from distributed_sgd_tpu import trace as trace_mod
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+log = logging.getLogger("dsgd.health")
+
+ACTIONS = ("warn", "snapshot", "halt")
+
+
+class HealthMonitor:
+    def __init__(
+        self,
+        metrics: Optional[metrics_mod.Metrics] = None,
+        action: str = "warn",
+        alpha: float = 0.3,
+        divergence_ratio: float = 2.0,
+        warmup: int = 3,
+        patience: int = 2,
+    ):
+        if action not in ACTIONS:
+            raise ValueError(
+                f"health action {action!r} must be one of {ACTIONS}")
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("alpha must be in (0, 1]")
+        if divergence_ratio <= 1.0:
+            raise ValueError("divergence_ratio must be > 1")
+        self.metrics = metrics or metrics_mod.global_metrics()
+        self.action = action
+        self.alpha = float(alpha)
+        self.divergence_ratio = float(divergence_ratio)
+        self.warmup = max(0, int(warmup))
+        self.patience = max(1, int(patience))
+        self._ewma: Optional[float] = None
+        self._best = math.inf
+        self._checks = 0
+        self._over = 0
+        self.tripped = False
+        self.trip_reason: Optional[str] = None
+
+    # -- per-round signals --------------------------------------------------
+
+    def observe_round(self, grad_norm: float,
+                      staleness_s: Optional[float] = None) -> bool:
+        """Record one fan-in round's gauges; returns True for EVERY
+        non-finite round (the caller must NOT apply the update).  The
+        trip itself — evidence dump, counter, action — still latches to
+        once per fit, but the sentinel verdict does not: a run that keeps
+        producing NaN rounds under action='warn' must keep dropping them,
+        not apply round two onward silently."""
+        self.metrics.gauge(metrics_mod.HEALTH_GRAD_NORM).set(grad_norm)
+        if staleness_s is not None:
+            self.metrics.gauge(metrics_mod.HEALTH_STALENESS).set(staleness_s)
+        if not math.isfinite(grad_norm):
+            self._trip("non_finite_grad", grad_norm=str(grad_norm))
+            return True
+        return False
+
+    # -- loss-trend watchdog ------------------------------------------------
+
+    def observe_loss(self, loss: float) -> bool:
+        """Record one loss evaluation; returns True when the watchdog
+        trips (divergence or non-finite loss)."""
+        if not math.isfinite(loss):
+            return self._trip("non_finite_loss", loss=str(loss))
+        ewma = (loss if self._ewma is None
+                else self.alpha * loss + (1 - self.alpha) * self._ewma)
+        self._ewma = ewma
+        self._checks += 1
+        self.metrics.gauge(metrics_mod.HEALTH_LOSS_EWMA).set(ewma)
+        if self._checks <= self.warmup:
+            self._best = min(self._best, ewma)
+            return False
+        if ewma > self.divergence_ratio * self._best:
+            self._over += 1
+            if self._over >= self.patience:
+                return self._trip("loss_divergence", ewma=round(ewma, 6),
+                                  best=round(self._best, 6),
+                                  ratio=self.divergence_ratio)
+        else:
+            self._over = 0
+            self._best = min(self._best, ewma)
+        return False
+
+    # -- trip ---------------------------------------------------------------
+
+    def _trip(self, reason: str, **info) -> bool:
+        if self.tripped:
+            return False  # latched: one dump / one action per fit
+        self.tripped = True
+        self.trip_reason = reason
+        self.metrics.counter(metrics_mod.HEALTH_TRIPPED).increment()
+        log.error("training-health watchdog tripped: %s %s (action=%s)",
+                  reason, info, self.action)
+        # evidence first, policy second: the flight dump is what a
+        # post-mortem reads even when the action is just 'warn'
+        trace_mod.event(trace_mod.EVENT_HEALTH_TRIPPED, reason=reason, **info)
+        flight.record("health.tripped", reason=reason,
+                      action=self.action, **info)
+        flight.dump("health")
+        return True

@@ -63,6 +63,7 @@ EVENT_BARRIER_STALLED = "barrier.stalled"  # soft deadline overrun, no relief
 EVENT_BCAST_STALE = "bcast.stale"          # stale replica -> full fallback
 EVENT_EF_ROLLBACK = "ef.rollback"          # worker rolled back an EF drain
 EVENT_TOPOLOGY_RESELECT = "topology.reselect"  # gossip edge re-routed past a breaker
+EVENT_HEALTH_TRIPPED = "health.tripped"        # training-health watchdog trip
 
 
 class TraceContext(NamedTuple):

@@ -46,6 +46,29 @@ class Counter:
         return self._value
 
 
+class Gauge:
+    """Last-write-wins instantaneous value (docs/OBSERVABILITY.md).
+
+    The training-health monitor (telemetry/health.py) publishes per-round
+    signals — gradient norm, EF residual norm, reply staleness, drain
+    backlog — that are neither monotone (Counter) nor distributional
+    (Histogram): the CURRENT value is the signal.  Merge semantics across
+    the cluster telemetry plane are last-write per label — gauges are
+    re-exported per worker, never summed (telemetry/aggregate.py)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        # plain float slot: a GIL-atomic assignment needs no lock, and the
+        # hot paths that set gauges (per sync round / per dispatch) must
+        # not pay one
+        self.value = float("nan")
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
 class Histogram:
     """Streaming histogram: count/sum/min/max/mean/last + quantiles +
     fixed log-spaced buckets.
@@ -173,6 +196,16 @@ def _prom_escape(s: str) -> str:
             .replace("\n", "\\n"))
 
 
+def prom_name(name: str, suffix: str = "") -> str:
+    """Instrument name -> Prometheus identifier.  The ONE mangling rule,
+    shared by the per-process exporter, the cluster exposition
+    (telemetry/aggregate.py), and the dashboard/alert generator
+    (telemetry/provision.py) — three hand-rolled copies would
+    desynchronize the exposition from the artifacts the moment the rule
+    grew a character class."""
+    return name.replace(".", "_").replace("-", "_") + suffix
+
+
 class Metrics:
     """Thread-safe named-instrument registry."""
 
@@ -180,6 +213,7 @@ class Metrics:
         self.tags = dict(tags or {})
         self._counters: Dict[str, Counter] = {}
         self._hists: Dict[str, Histogram] = {}
+        self._gauges: Dict[str, Gauge] = {}
         self._lock = threading.Lock()
 
     def counter(self, name: str) -> Counter:
@@ -190,8 +224,27 @@ class Metrics:
         with self._lock:
             return self._hists.setdefault(name, Histogram(name))
 
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge(name))
+
     def timer(self, name: str) -> Timer:
         return Timer(self.histogram(name))
+
+    # snapshot accessors for the telemetry plane (telemetry/aggregate.py):
+    # stable lists, safe to iterate while other threads register/record
+
+    def counters(self) -> List[Counter]:
+        with self._lock:
+            return list(self._counters.values())
+
+    def histograms(self) -> List[Histogram]:
+        with self._lock:
+            return list(self._hists.values())
+
+    def gauges(self) -> List[Gauge]:
+        with self._lock:
+            return list(self._gauges.values())
 
     # -- exporters ---------------------------------------------------------
 
@@ -199,11 +252,14 @@ class Metrics:
         tags = ",".join(f'{k}="{_prom_escape(v)}"'
                         for k, v in sorted(self.tags.items()))
         tagstr = "{" + tags + "}" if tags else ""
-
-        def mangle(name: str) -> str:
-            return name.replace(".", "_").replace("-", "_")
-
+        mangle = prom_name
         lines: List[str] = []
+        for g in list(self._gauges.values()):
+            if g.value != g.value:  # never-set (NaN) gauges stay unexported
+                continue
+            base = mangle(g.name)
+            lines.append(f"# TYPE {base} gauge")
+            lines.append(f"{base}{tagstr} {g.value}")
         for c in list(self._counters.values()):
             base = mangle(c.name)
             # conventional counter spelling: the `_total` family is the
@@ -255,6 +311,11 @@ class Metrics:
         tags = "".join(f",{_influx_escape(k)}={_influx_escape(v)}"
                        for k, v in sorted(self.tags.items()))
         lines = []
+        for g in list(self._gauges.values()):
+            if g.value == g.value:  # skip never-set NaN gauges
+                lines.append(
+                    f"{_influx_escape_measurement(g.name)}{tags} "
+                    f"value={g.value} {ts}")
         for c in list(self._counters.values()):
             lines.append(
                 f"{_influx_escape_measurement(c.name)}{tags} "
@@ -345,6 +406,33 @@ ASYNC_DRAIN_SIZE = "master.async.drain.size"       # histogram: messages per dra
 ASYNC_DRAIN_FALLBACK = "master.async.drain.fallback"  # full inbox -> per-message
 TOPOLOGY_RESELECT = "slave.async.topology.reselect"  # edges re-routed past breakers
 
+# -- cluster telemetry plane (telemetry/, docs/OBSERVABILITY.md) --------------
+#
+# Master-side instruments for the Metrics-RPC scrape fan-out (heartbeat-
+# piggybacked + on-demand at the cluster /metrics endpoint).  Scrape
+# outcomes NEVER feed the per-peer circuit breakers — a flaky metrics
+# reply must not open the breaker the training RPCs depend on — so the
+# scrape only CONSULTS breakers read-only (`skipped`) and accounts its
+# own failures here.
+TELEMETRY_SCRAPES = "master.telemetry.scrapes"      # counter: scrape fan-outs run
+TELEMETRY_SCRAPE_ERRORS = "master.telemetry.scrape.errors"  # counter: failed worker scrapes
+TELEMETRY_SCRAPE_SKIPPED = "master.telemetry.scrape.skipped"  # counter: breaker-suppressed
+TELEMETRY_WORKERS = "master.telemetry.workers"      # gauge: snapshots currently held
+
+# -- training-health monitor (telemetry/health.py) ----------------------------
+#
+# The signals that predict a dying run (ISSUE 7): per-round/dispatch
+# gauges published by whichever node computes the quantity (master:
+# fan-in gradient norm + round staleness + drain backlog; workers: their
+# own gradient norm, dispatch staleness, EF residual norm), and the
+# loss-trend watchdog's EWMA + trip counter on the master.
+HEALTH_GRAD_NORM = "health.grad.norm"               # gauge: ||g||2 of the last round
+HEALTH_STALENESS = "health.reply.staleness_s"       # gauge: round latency / dispatch gap
+HEALTH_EF_RESIDUAL_NORM = "health.ef.residual.norm"  # gauge: ||EF residual||2 (workers)
+HEALTH_DRAIN_BACKLOG = "health.drain.backlog"       # gauge: async inbox depth (master)
+HEALTH_LOSS_EWMA = "health.loss.ewma"               # gauge: watchdog's smoothed loss
+HEALTH_TRIPPED = "health.tripped"                   # counter: watchdog trips
+
 
 _GLOBAL = Metrics()
 
@@ -361,6 +449,10 @@ def histogram(name: str) -> Histogram:
     return _GLOBAL.histogram(name)
 
 
+def gauge(name: str) -> Gauge:
+    return _GLOBAL.gauge(name)
+
+
 def timer(name: str) -> Timer:
     return _GLOBAL.timer(name)
 
@@ -371,12 +463,21 @@ class PrometheusExporter:
     Replaces the reference's Kamon InfluxDBReporter push loop
     (Main.scala:40-43, application.conf:54-77) with the pull model native to
     the k8s deployments in kube/.
+
+    `render` (default: the registry's own `prometheus_text`) produces the
+    exposition body; `refresh`, when given, runs before each render — the
+    cluster telemetry endpoint (telemetry/aggregate.ClusterExporter) uses
+    it to trigger the master's throttled scrape, so both endpoints share
+    ONE routing/header/threading implementation.
     """
 
-    def __init__(self, metrics: Metrics, port: int, host: str = "0.0.0.0"):
+    def __init__(self, metrics: Optional[Metrics], port: int,
+                 host: str = "0.0.0.0", render=None, refresh=None):
         self.metrics = metrics
+        self.render = render or metrics.prometheus_text
+        self.refresh = refresh
 
-        registry = metrics
+        outer = self
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
@@ -391,7 +492,12 @@ class PrometheusExporter:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                body = registry.prometheus_text().encode()
+                if outer.refresh is not None:
+                    try:
+                        outer.refresh()
+                    except Exception:  # noqa: BLE001 - serve the stale view
+                        pass
+                body = outer.render().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
@@ -403,7 +509,8 @@ class PrometheusExporter:
 
         self._server = http.server.ThreadingHTTPServer((host, port), Handler)
         self.port = self._server.server_address[1]
-        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
 
     def start(self) -> "PrometheusExporter":
         self._thread.start()

@@ -6,6 +6,8 @@ below one lane (D < 128), exactly on a block edge (D = 128k), one-past
 shape) pair must agree with the scalar-path kernels.
 """
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -48,6 +50,12 @@ def test_mxu_kernels_all_shapes(d, bp):
     )
 
 
+@pytest.mark.skipif(
+    os.environ.get("DSGD_PALLAS", "") != "1"
+    and not pallas_sparse.pallas_supported(),
+    reason="pallas kernel unsupported on this jax (pallas_supported() "
+    "probe failed) and DSGD_PALLAS=1 not set; measured-rejection record "
+    "in BASELINE.md / ROADMAP item 2")
 @pytest.mark.parametrize("d", [1, 127, 129, 1025])
 @pytest.mark.parametrize("bp", BATCHES)
 def test_pallas_kernel_all_shapes(d, bp):

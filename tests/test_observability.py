@@ -337,6 +337,83 @@ def test_every_instrument_constant_is_recorded_somewhere():
         "package (spelling drift): " + ", ".join(missing))
 
 
+# -- provisioned dashboards / alert rules (telemetry/provision.py) ------------
+
+KUBE_OBS = os.path.join(os.path.dirname(PKG_ROOT), "kube", "observability")
+
+
+def test_provisioned_observability_files_match_generator():
+    """The committed kube/observability artifacts must be EXACTLY what the
+    generator produces — editing the JSON/YAML by hand (or renaming an
+    instrument without regenerating) fails here.  Regenerate with
+    `python -m distributed_sgd_tpu.telemetry.provision`."""
+    from distributed_sgd_tpu.telemetry import provision
+
+    dash = open(os.path.join(KUBE_OBS, provision.DASHBOARD_FILE)).read()
+    assert dash == provision.render_dashboard()
+    alerts = open(os.path.join(KUBE_OBS, provision.ALERTS_FILE)).read()
+    assert alerts == provision.alert_rules()
+
+
+def _provisioned_prom_identifiers():
+    """Every Prometheus metric identifier referenced by the committed
+    dashboard + alert rules (instrument-shaped tokens only)."""
+    from distributed_sgd_tpu.telemetry import provision
+
+    text = (open(os.path.join(KUBE_OBS, provision.DASHBOARD_FILE)).read()
+            + open(os.path.join(KUBE_OBS, provision.ALERTS_FILE)).read())
+    return set(re.findall(
+        r"\b(?:master|slave|health|rpc|comms|serve)_[a-z0-9_]+", text))
+
+
+def test_every_dashboard_and_alert_metric_exists_in_code():
+    """No dashboard panel or alert rule may reference a metric the code
+    never records: every prom identifier in the artifacts must reduce (by
+    stripping the exposition suffixes) to an instrument whose dotted name
+    appears in the package sources."""
+    from distributed_sgd_tpu.telemetry import provision
+
+    known = {provision._prom(name): name
+             for name in provision.REFERENCED_INSTRUMENTS}
+    sources = _package_sources()
+    suffixes = ("_total", "_hist_bucket", "_hist_sum", "_hist_count",
+                "_bucket", "_count", "_sum", "_min", "_max", "_last", "")
+    stray, unrecorded = [], []
+    for ident in sorted(_provisioned_prom_identifiers()):
+        base = next((ident[: len(ident) - len(s)] for s in suffixes
+                     if s and ident.endswith(s)), ident)
+        name = known.get(base) or known.get(ident)
+        if name is None:
+            stray.append(ident)
+            continue
+        lit = re.compile(rf"[\"']{re.escape(name)}[\"']")
+        if not any(lit.search(src) for src in sources.values()):
+            unrecorded.append(f"{ident} -> {name}")
+    assert not stray, (
+        "dashboard/alert metrics with no REFERENCED_INSTRUMENTS entry "
+        "(telemetry/provision.py): " + ", ".join(stray))
+    assert not unrecorded, (
+        "dashboard/alert metrics whose instrument is never recorded in "
+        "the package: " + ", ".join(unrecorded))
+
+
+def test_core_instruments_are_dashboarded():
+    """The vice-versa direction for the curated core set: the signals
+    ISSUE 7 calls out (rounds, gradient norm, staleness, loss EWMA,
+    health trips, quorum degradation, scrape errors, breaker opens) must
+    actually appear in the provisioned artifacts."""
+    from distributed_sgd_tpu.telemetry import provision
+
+    idents = _provisioned_prom_identifiers()
+    missing = [
+        name for name in provision.CORE_INSTRUMENTS
+        if not any(i.startswith(provision._prom(name)) for i in idents)
+    ]
+    assert not missing, (
+        "core instruments absent from the provisioned dashboard/alerts: "
+        + ", ".join(missing))
+
+
 def test_every_allowlisted_span_name_is_used():
     sources = _package_sources()
     missing = [

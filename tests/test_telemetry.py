@@ -1,0 +1,480 @@
+"""Cluster telemetry plane + training-health monitor (telemetry/,
+docs/OBSERVABILITY.md, ISSUE 7).
+
+Merge semantics (counters sum idempotently across scrapes, histogram
+buckets sum exactly across workers, gauges last-write per label), scrape
+degradation (dead worker, breaker-open worker — bounded, never stalling
+the heartbeat loop), the knobs-off discipline (no Metrics RPC ever
+issued, no existing proto message gained a field), the heartbeat
+piggyback, the e2e DevCluster chaos+quorum fit behind ONE cluster
+/metrics endpoint, and the health watchdog's trip -> flight dump ->
+resumable snapshot -> resume cycle."""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import (
+    RpcPolicy,
+    WorkerStub,
+    add_worker_servicer,
+    new_channel,
+    new_server,
+)
+from distributed_sgd_tpu.telemetry import aggregate
+from distributed_sgd_tpu.telemetry.health import HealthMonitor
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import metrics as mm
+from distributed_sgd_tpu.utils.metrics import Histogram, Metrics
+
+
+@pytest.fixture(scope="module")
+def data():
+    d = rcv1_like(192, n_features=96, nnz=8, noise=0.0, seed=11,
+                  idf_values=True)
+    return train_test_split(d)
+
+
+@pytest.fixture(scope="module")
+def model_fn(data):
+    train, _ = data
+    ds = dim_sparsity(train)
+    return lambda: make_model("hinge", 1e-5, train.n_features,
+                              dim_sparsity=ds)
+
+
+# -- snapshot round-trip + merge semantics ------------------------------------
+
+
+def test_snapshot_roundtrips_every_instrument_kind():
+    m = Metrics()
+    m.counter("c.a").increment(7)
+    m.gauge("g.a").set(2.5)
+    m.gauge("g.never_set")  # NaN: must stay off the wire
+    h = m.histogram("h.a")
+    for v in (0.01, 0.5, 3.0):
+        h.record(v)
+    snap = pb.MetricsSnapshot.FromString(
+        aggregate.snapshot_metrics(m, "worker", "w0").SerializeToString())
+    assert snap.role == "worker" and snap.node == "w0"
+    assert {c.name: c.value for c in snap.counters} == {"c.a": 7}
+    assert {g.name: round(g.value, 6) for g in snap.gauges} == {"g.a": 2.5}
+    (hm,) = snap.hists
+    assert hm.count == 3 and hm.min == 0.01 and hm.max == 3.0 and hm.last == 3.0
+    assert list(hm.buckets) == h.bucket_counts()
+
+
+def test_counter_merge_sums_workers_and_is_scrape_idempotent():
+    master = Metrics()
+    tel = aggregate.ClusterTelemetry(master, node="master", role="master")
+    w0, w1 = Metrics(), Metrics()
+    w0.counter("slave.sync.backward").increment(3)
+    w1.counter("slave.sync.backward").increment(5)
+    tel.observe(("h", 1), aggregate.snapshot_metrics(w0, "worker", "h:1"))
+    tel.observe(("h", 2), aggregate.snapshot_metrics(w1, "worker", "h:2"))
+    # scraping the SAME state again must not inflate anything
+    tel.observe(("h", 1), aggregate.snapshot_metrics(w0, "worker", "h:1"))
+    text = tel.prometheus_text()
+    assert 'slave_sync_backward_total{role="worker",worker="h:1"} 3' in text
+    assert 'slave_sync_backward_total{role="worker",worker="h:2"} 5' in text
+    assert 'slave_sync_backward_total{role="cluster"} 8' in text
+    # progress on one worker is reflected, not accumulated
+    w0.counter("slave.sync.backward").increment(4)
+    tel.observe(("h", 1), aggregate.snapshot_metrics(w0, "worker", "h:1"))
+    assert ('slave_sync_backward_total{role="cluster"} 12'
+            in tel.prometheus_text())
+
+
+def test_histogram_buckets_merge_exactly_across_workers():
+    w0, w1 = Metrics(), Metrics()
+    vals0 = [1e-5, 0.003, 0.7, 42.0]
+    vals1 = [0.003, 0.003, 5.0, 1e9]  # 1e9 lands only in +Inf
+    for v in vals0:
+        w0.histogram("rpc.wait").record(v)
+    for v in vals1:
+        w1.histogram("rpc.wait").record(v)
+    text = aggregate.cluster_prometheus_text([
+        aggregate.snapshot_metrics(w0, "worker", "w0"),
+        aggregate.snapshot_metrics(w1, "worker", "w1"),
+    ])
+    bucket_re = re.compile(
+        r'rpc_wait_hist_bucket\{role="cluster",le="([^"]+)"\} (\d+)')
+    buckets = [(le, int(n)) for le, n in bucket_re.findall(text)]
+    assert len(buckets) == len(Histogram.BUCKET_BOUNDS) + 1
+    both = vals0 + vals1
+    for le_s, n in buckets[:-1]:
+        assert n == sum(1 for v in both if v <= float(le_s)), le_s
+    assert buckets[-1] == ("+Inf", len(both))
+    assert f'rpc_wait_hist_count{{role="cluster"}} {len(both)}' in text
+    # per-node scalar views ride along
+    assert 'rpc_wait_count{role="worker",worker="w0"} 4' in text
+    assert 'rpc_wait_last{role="worker",worker="w1"} 1000000000.0' in text
+
+
+def test_gauges_are_last_write_per_label_never_aggregated():
+    w0, w1 = Metrics(), Metrics()
+    w0.gauge(mm.HEALTH_GRAD_NORM).set(1.0)
+    w0.gauge(mm.HEALTH_GRAD_NORM).set(3.0)  # last write wins
+    w1.gauge(mm.HEALTH_GRAD_NORM).set(2.0)
+    text = aggregate.cluster_prometheus_text([
+        aggregate.snapshot_metrics(w0, "worker", "w0"),
+        aggregate.snapshot_metrics(w1, "worker", "w1"),
+    ])
+    assert 'health_grad_norm{role="worker",worker="w0"} 3.0' in text
+    assert 'health_grad_norm{role="worker",worker="w1"} 2.0' in text
+    # no cluster aggregate exists for a gauge family
+    assert not re.search(r'health_grad_norm\{role="cluster"\}', text)
+
+
+# -- scrape degradation -------------------------------------------------------
+
+
+class _MetricsServicer:
+    """Minimal worker-servicer shape: real Metrics + Ping, everything
+    else answers UNIMPLEMENTED (the builder requires the full core
+    surface; only `Metrics` itself is optional — rpc/service.py
+    _OPTIONAL_METHODS)."""
+
+    def __init__(self, registry: Metrics, node: str):
+        self.registry = registry
+        self.node = node
+        self.calls = 0
+
+    def Ping(self, request, context):  # noqa: N802
+        return pb.Ack()
+
+    def Metrics(self, request, context):  # noqa: N802
+        self.calls += 1
+        return aggregate.snapshot_metrics(self.registry, "worker", self.node)
+
+    def __getattr__(self, name):
+        def unimplemented(request, context):
+            import grpc
+
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, name)
+
+        return unimplemented
+
+
+def test_scrape_of_dead_worker_degrades_without_stalling():
+    reg = Metrics()
+    reg.counter("c.x").increment(2)
+    sv = _MetricsServicer(reg, "live:1")
+    server = new_server(0, host="127.0.0.1")
+    add_worker_servicer(server, sv)
+    server.start()
+    dead_server = new_server(0, host="127.0.0.1")
+    dead_port = dead_server.bound_port  # bound then immediately stopped
+    dead_server.stop(grace=0)
+    master = Metrics()
+    tel = aggregate.ClusterTelemetry(master)
+    policy = RpcPolicy(deadline_s=2.0, metrics=master)
+    ch_live = new_channel("127.0.0.1", server.bound_port)
+    ch_dead = new_channel("127.0.0.1", dead_port)
+    try:
+        members = [(("live", 1), WorkerStub(ch_live)),
+                   (("dead", 2), WorkerStub(ch_dead))]
+        t0 = time.monotonic()
+        got = tel.scrape(members, policy)
+        wall = time.monotonic() - t0
+        assert got == 1
+        assert wall < 2.0 + 1.0, "scrape must be bounded by one deadline"
+        assert master.counter(mm.TELEMETRY_SCRAPE_ERRORS).value == 1
+        assert 'c_x_total{role="worker",worker="live:1"} 2' in tel.prometheus_text()
+    finally:
+        ch_live.close()
+        ch_dead.close()
+        server.stop(grace=0)
+
+
+def test_scrape_skips_breaker_open_worker_without_consuming_probe():
+    reg = Metrics()
+    sv = _MetricsServicer(reg, "w:1")
+    server = new_server(0, host="127.0.0.1")
+    add_worker_servicer(server, sv)
+    server.start()
+    master = Metrics()
+    tel = aggregate.ClusterTelemetry(master)
+    policy = RpcPolicy(deadline_s=2.0, breaker_failures=1, metrics=master)
+    key = ("w", 1)
+    policy.breaker(key).record_failure()  # trip it (failures=1)
+    assert policy.breaker(key).suppressed()
+    ch = new_channel("127.0.0.1", server.bound_port)
+    try:
+        got = tel.scrape([(key, WorkerStub(ch))], policy)
+        assert got == 0 and sv.calls == 0
+        assert master.counter(mm.TELEMETRY_SCRAPE_SKIPPED).value == 1
+        # the read-only consult left the half-open probe slot intact
+        assert policy.breaker(key).suppressed()
+    finally:
+        ch.close()
+        server.stop(grace=0)
+
+
+def test_missing_required_method_still_fails_loudly_missing_metrics_degrades():
+    """Only `Metrics` is optional on a servicer: a stub lacking a CORE
+    method fails server construction (the pre-telemetry contract), while
+    one lacking just Metrics builds fine and scrapes degrade to the
+    error counter (UNIMPLEMENTED from an older binary)."""
+    from distributed_sgd_tpu.rpc.service import add_worker_servicer as add_w
+
+    class MissingCore:
+        def Ping(self, request, context):  # noqa: N802
+            return pb.Ack()
+
+    server = new_server(0, host="127.0.0.1")
+    with pytest.raises(AttributeError):
+        add_w(server, MissingCore())
+    server.stop(grace=0)
+
+    def _ack(self, request, context):
+        return pb.Ack()
+
+    class NoMetrics:  # full core surface, predates the Metrics RPC
+        RegisterSlave = UnregisterSlave = Ping = Forward = _ack
+        Gradient = StartAsync = StopAsync = UpdateGrad = _ack
+
+    server = new_server(0, host="127.0.0.1")
+    add_w(server, NoMetrics())
+    server.start()
+    master = Metrics()
+    tel = aggregate.ClusterTelemetry(master)
+    policy = RpcPolicy(deadline_s=2.0, metrics=master)
+    ch = new_channel("127.0.0.1", server.bound_port)
+    try:
+        assert tel.scrape([(("old", 1), WorkerStub(ch))], policy) == 0
+        assert master.counter(mm.TELEMETRY_SCRAPE_ERRORS).value == 1
+    finally:
+        ch.close()
+        server.stop(grace=0)
+
+
+# -- knobs-off discipline -----------------------------------------------------
+
+
+def test_new_proto_surface_leaves_existing_messages_untouched():
+    """The telemetry splice adds NEW messages only: every pre-telemetry
+    message keeps its exact field list, so the default wire stays
+    byte-identical by construction (unset proto3 fields serialize to
+    nothing, and no field was added to be unset)."""
+    expect = {
+        "GradientRequest": ["weights", "samples", "fit_token", "delta",
+                           "step_version", "local_steps", "learning_rate",
+                           "batch_size", "ef_rollback_version", "hedge"],
+        "GradUpdate": ["dense", "sparse", "n_steps", "compressed",
+                       "stale_version"],
+        "ForwardRequest": ["samples", "weights", "want_margins"],
+        "ForwardReply": ["predictions", "margins"],
+        "StartAsyncRequest": ["weights", "samples", "batch_size",
+                              "learning_rate", "optimizer", "momentum"],
+        "WeightDelta": ["base_version", "indices", "values"],
+    }
+    for msg, fields in expect.items():
+        got = [f.name for f in getattr(pb, msg).DESCRIPTOR.fields]
+        assert got == fields, (msg, got)
+    # and the new surface exists, separately
+    assert [f.name for f in pb.MetricsSnapshot.DESCRIPTOR.fields] == [
+        "role", "node", "counters", "gauges", "hists"]
+
+
+def test_knobs_off_fit_issues_no_metrics_rpc(data, model_fn, monkeypatch):
+    train, test = data
+    calls = []
+    orig = aggregate.snapshot_metrics
+    monkeypatch.setattr(aggregate, "snapshot_metrics",
+                        lambda *a, **k: calls.append(1) or orig(*a, **k))
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        assert c.master.telemetry is None
+        c.master.fit_sync(max_epochs=1, batch_size=16, learning_rate=0.5)
+    assert not calls, "a default-config fit touched the telemetry plane"
+
+
+# -- heartbeat piggyback ------------------------------------------------------
+
+
+def test_heartbeat_piggybacks_the_scrape(data, model_fn):
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2,
+                    heartbeat_s=0.2, telemetry_port=0) as c:
+        # an idle worker's registry is empty and contributes no series:
+        # give each one an instrument so its snapshot is visible
+        for w in c.workers:
+            w.metrics.counter("slave.sync.backward").increment()
+        # wait until the piggybacked scrapes have actually LANDED both
+        # worker snapshots (the first attempts can miss the short probe
+        # deadline while channels warm up under test load)
+        deadline = time.monotonic() + 20.0
+        text = ""
+        while time.monotonic() < deadline:
+            text = c.master.telemetry.prometheus_text()
+            if len(set(re.findall(r'worker="([^"]+)"', text))) >= 3:
+                break
+            time.sleep(0.05)
+        assert c.master.metrics.counter(mm.TELEMETRY_SCRAPES).value >= 1
+        assert c.master.metrics.gauge(mm.TELEMETRY_WORKERS).value == 2.0
+    # both workers' snapshots arrived without anybody hitting the endpoint
+    workers = set(re.findall(r'worker="([^"]+)"', text))
+    assert len(workers) >= 3  # master + 2 workers
+
+
+def test_record_health_reports_async_ef_residual(data, model_fn):
+    """The EF gauge must follow the engine's residual destination: the
+    async gossip loop drains dest='master' (not 'sync:master'), and a
+    compressed async fit's residual growth is exactly the dying-run
+    signal the dashboards advertise."""
+    from distributed_sgd_tpu.core.worker import WorkerNode
+
+    train, _ = data
+    w = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, train, model_fn(),
+                   metrics=Metrics(), compress="topk", compress_k=0.1,
+                   telemetry=True)
+    try:
+        g = np.linspace(1.0, 2.0, train.n_features).astype(np.float32)
+        w._compressor.compress(g, dest="master")  # async-loop destination
+        w.record_health(g)
+        assert w.metrics.gauge(mm.HEALTH_EF_RESIDUAL_NORM).value > 0
+    finally:
+        w.stop()
+
+
+# -- e2e: chaos + quorum fit behind one cluster endpoint ----------------------
+
+
+def test_e2e_chaos_fit_exposes_cluster_endpoint(data, model_fn):
+    """Acceptance path (ISSUE 7): a DevCluster fit under a DSGD_CHAOS plan
+    exposes ONE cluster-level /metrics endpoint with per-worker-labeled
+    gradient-norm and staleness gauges from every node plus the master's
+    quorum series."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2,
+                    chaos="seed=3;delay=20ms~80ms",
+                    telemetry_port=0) as c:
+        c.master.fit_sync(max_epochs=2, batch_size=16, learning_rate=0.5,
+                          grad_timeout_s=5.0, quorum=1,
+                          straggler_soft_s=0.05)
+        port = c.master.telemetry_exporter.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        worker_labels = {f"{w.host}:{w.port}" for w in c.workers}
+        # 404 routing contract, same as the per-process exporter
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=10)
+    grad = re.findall(r'health_grad_norm\{role="worker",worker="([^"]+)"\}',
+                      body)
+    assert set(grad) == worker_labels, "gradient-norm gauge missing a worker"
+    stale = re.findall(
+        r'health_reply_staleness_s\{role="worker",worker="([^"]+)"\}', body)
+    assert set(stale) == worker_labels
+    assert re.search(r'master_sync_rounds_total\{role="cluster"\} [1-9]', body)
+    # quorum series from the master, on the same endpoint: under 20-80 ms
+    # injected delays and a 50 ms soft deadline, rounds MUST have either
+    # degraded, stalled, hedged, or discarded a late reply
+    quorum_families = (
+        "master_sync_quorum_degraded_total", "master_sync_quorum_hedges_total",
+        "master_sync_quorum_late_total", "master_sync_barrier_stalled_total")
+    total = 0
+    for fam in quorum_families:
+        for m in re.finditer(rf"{fam}\{{[^}}]*\}} (\d+)", body):
+            total += int(m.group(1))
+    assert total > 0, "no quorum-pressure series on the cluster endpoint"
+
+
+# -- training-health monitor --------------------------------------------------
+
+
+def test_health_monitor_ewma_divergence_and_sentinels():
+    m = Metrics()
+    h = HealthMonitor(metrics=m, action="warn", alpha=0.5,
+                      divergence_ratio=1.5, warmup=2, patience=2)
+    assert not h.observe_loss(1.0)
+    assert not h.observe_loss(0.9)      # warmup done, best ~0.95
+    assert not h.observe_loss(1.0)      # fine
+    assert not h.observe_loss(4.0)      # over once
+    assert h.observe_loss(6.0)          # over twice -> trip
+    assert h.tripped and h.trip_reason == "loss_divergence"
+    assert m.counter(mm.HEALTH_TRIPPED).value == 1
+    assert not h.observe_loss(50.0)     # latched: no second trip
+    assert m.counter(mm.HEALTH_TRIPPED).value == 1
+
+    h2 = HealthMonitor(metrics=m, action="warn")
+    assert h2.observe_loss(float("nan"))
+    assert h2.trip_reason == "non_finite_loss"
+
+    h3 = HealthMonitor(metrics=m, action="warn")
+    assert not h3.observe_round(1.25, staleness_s=0.5)
+    assert m.gauge(mm.HEALTH_GRAD_NORM).value == 1.25
+    assert m.gauge(mm.HEALTH_STALENESS).value == 0.5
+    assert h3.observe_round(float("inf"))
+    assert h3.trip_reason == "non_finite_grad"
+    # the trip latches (one dump/action) but the sentinel VERDICT does
+    # not: every later non-finite round must still be reported so the
+    # fit keeps dropping poisoned updates under action='warn'
+    assert h3.observe_round(float("nan"))
+    # the trip counter saw one trip per monitor (h, h2, h3) — h3's second
+    # non-finite round reported True WITHOUT tripping again
+    assert m.counter(mm.HEALTH_TRIPPED).value == 3
+
+
+def test_health_halt_dumps_flight_and_leaves_resumable_snapshot(
+        data, model_fn, tmp_path, monkeypatch):
+    """Acceptance path (ISSUE 7): an injected loss divergence trips the
+    watchdog, which dumps flight evidence and a resumable fit-state
+    snapshot; restoring it resumes the fit where the halt interrupted."""
+    from distributed_sgd_tpu.checkpoint import restore_fit_state
+
+    train, test = data
+    flight.configure(capacity=64, service="t-health", dir=str(tmp_path))
+    fit_state = str(tmp_path / "fit_state.npz")
+    try:
+        with DevCluster(model_fn(), train, test, n_workers=2) as c:
+            orig = c.master.local_loss
+            boost = [1.0]
+
+            # injected divergence: each successive TRAIN eval (the series
+            # the watchdog observes) sees 10x the previous multiplier
+            def diverging(w, test=False):
+                loss, acc = orig(w, test=test)
+                out = loss * boost[0]
+                if not test:
+                    boost[0] *= 10.0
+                return out, acc
+
+            c.master.local_loss = diverging
+            h = HealthMonitor(metrics=c.master.metrics, action="halt",
+                              alpha=0.5, divergence_ratio=1.5, warmup=1,
+                              patience=1)
+            res = c.master.fit_sync(
+                max_epochs=6, batch_size=16, learning_rate=0.5, health=h,
+                fit_state_path=fit_state, fit_state_every=0)
+        assert h.tripped and h.trip_reason == "loss_divergence"
+        assert res.epochs_run < 6, "halt action did not stop the fit"
+        dumps = list(tmp_path.glob("flight-t-health-*-health.json"))
+        assert dumps, "no flight evidence dumped on the health trip"
+        events = json.load(open(dumps[0]))["events"]
+        assert any(e["kind"] == "health.tripped" for e in events)
+
+        fs = restore_fit_state(fit_state, "sgd", [])
+        assert fs is not None and not fs.finished
+        assert fs.epoch == res.epochs_run and fs.batch == 0
+        halted_at = res.epochs_run
+
+        # resume: a fresh fit (health off) picks the snapshot up and runs
+        # the remaining budget
+        with DevCluster(model_fn(), train, test, n_workers=2) as c2:
+            res2 = c2.master.fit_sync(
+                max_epochs=halted_at + 2, batch_size=16, learning_rate=0.5,
+                fit_state_path=fit_state, fit_state_every=0)
+        assert res2.epochs_run == halted_at + 2
+        assert np.isfinite(res2.state.loss)
+    finally:
+        flight.configure(capacity=flight.DEFAULT_CAPACITY)
