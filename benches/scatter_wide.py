@@ -213,20 +213,30 @@ def fused_ab() -> None:
     model = SparseSVM(lam=1e-5, n_features=N_FEATURES, dim_sparsity=jnp.asarray(ds))
     data = Dataset(indices=idx, values=val, labels=y, n_features=N_FEATURES)
 
-    def epoch_s(label, cls):
+    def epoch_s(label, cls, formulation=None):
+        # two override mechanisms, one harness: the round-4 wide-output
+        # layouts are OneHotBatch subclasses (monkeypatched in), the
+        # round-6 formulations are registry backends (ops/mxu.py
+        # DSGD_SCATTER) scoped around engine build + trace
         orig = mxu.OneHotBatch
-        mxu.OneHotBatch = cls
-        lin.mxu.OneHotBatch = cls
+        if cls is not None:
+            mxu.OneHotBatch = cls
+            lin.mxu.OneHotBatch = cls
         try:
             eng = SyncEngine(model, make_mesh(1), batch_size=b,
-                             learning_rate=0.5, virtual_workers=k)
+                             learning_rate=0.5, virtual_workers=k,
+                             scatter=formulation)
             bound = eng.bind(data)
-            w0 = jnp.zeros(N_FEATURES, jnp.float32)
             key = jax.random.PRNGKey(0)
-            np.asarray(bound.multi_epoch(w0, key, 1))
-            np.asarray(bound.multi_epoch(w0, key, 3))
-            t1 = timed_best(lambda: np.asarray(bound.multi_epoch(w0, key, 1)), reps=5)
-            t3 = timed_best(lambda: np.asarray(bound.multi_epoch(w0, key, 3)), reps=5)
+
+            def run(n_ep):
+                return np.asarray(bound.multi_epoch(
+                    jnp.zeros(N_FEATURES, jnp.float32), key, n_ep))
+
+            run(1)
+            run(3)
+            t1 = timed_best(lambda: run(1), reps=5)
+            t3 = timed_best(lambda: run(3), reps=5)
             e = (t3 - t1) / 2
             log(f"{label}: epoch {e:.4f}s, step "
                 f"{e/bound.steps_per_epoch*1e6:.1f}us")
@@ -235,16 +245,23 @@ def fused_ab() -> None:
             mxu.OneHotBatch = orig
             lin.mxu.OneHotBatch = orig
 
-    variants = {"single_dot": mxu.OneHotBatch, "batched_s4": BatchedScatter,
-                "shared_wide": SharedWide}
+    # round-4 wide-output layouts + the round-6 selectable formulations
+    # (ops/mxu.py; 'single_dot' IS 'onehot') in one interleaved A/B
+    variants = {"single_dot": (mxu.OneHotBatch, None),
+                "batched_s4": (BatchedScatter, None),
+                "shared_wide": (SharedWide, None),
+                "segment": (None, "segment"),
+                "twostage": (None, "twostage"),
+                "bf16": (None, "bf16")}
     # interleave two passes over all variants to cancel shared-chip drift
     times: dict = {name: [] for name in variants}
     for rep in range(2):
-        for name, cls in variants.items():
-            times[name].append(epoch_s(f"{name} ({rep + 1})", cls))
+        for name, (cls, form) in variants.items():
+            times[name].append(epoch_s(f"{name} ({rep + 1})", cls, form))
     base = min(times["single_dot"])
     out = {
         "study": "scatter_fused_ab", "interleaved_reps": 2,
+        "device": jax.devices()[0].platform,
         "results": {
             name: {"epoch_s_best": round(min(ts), 4),
                    "epoch_s_all": [round(t, 4) for t in ts],

@@ -108,6 +108,80 @@ def _await_futures(futs, bytes_counter=None):
     return ok, failed
 
 
+class _ArrivalDecoder:
+    """Send-ordered decode-on-arrival for the sync fan-in (ROADMAP item 2).
+
+    The full-barrier fan-in used to decode every Gradient reply AFTER the
+    barrier closed — N dim-sized scatter-decodes serialized on the
+    critical path while N-1 of them could have run during the wait.  This
+    moves each reply's `codec.decode_grad_into(reply, grad_acc)` into the
+    reply's own arrival callback, constrained to SEND ORDER (the decode
+    cursor only advances over the contiguous settled prefix), so float
+    accumulation order — and therefore the resulting weights — stays
+    bit-identical to the post-barrier loop.  With in-order arrivals every
+    decode but the slowest reply's overlaps the wait; out-of-order
+    arrivals decode as soon as their prefix completes.
+
+    Lock-guarded: gRPC runs callbacks on its own threads.  Set-once per
+    index (`setdefault`), so a callback racing `finish()` can never decode
+    a reply twice.  A failed or stale reply marks the window dirty and
+    freezes the cursor — the caller retries the window and the
+    accumulator is re-zeroed on the next attempt, so partially-decoded
+    state never leaks into an applied update."""
+
+    def __init__(self, acc: np.ndarray):
+        self.acc = acc
+        self._lock = threading.Lock()
+        self._results: Dict[int, object] = {}
+        self._cursor = 0
+        self.dirty = False
+        self.decoded = 0
+
+    def watch(self, i: int, fut) -> None:
+        if fut is None:
+            with self._lock:
+                self._results.setdefault(i, None)
+                self._advance()
+            return
+        fut.add_done_callback(lambda f, i=i: self._on_done(i, f))
+
+    def _on_done(self, i: int, fut) -> None:
+        try:
+            reply = fut.result()
+        except Exception:  # noqa: BLE001 - classification is the barrier's job
+            reply = None
+        with self._lock:
+            self._results.setdefault(i, reply)
+            self._advance()
+
+    def _advance(self) -> None:
+        while not self.dirty and self._cursor in self._results:
+            r = self._results[self._cursor]
+            if r is None or r.stale_version:
+                # the window will retry: stop decoding (the work would be
+                # discarded) and let the caller's classification decide
+                self.dirty = True
+                return
+            codec.decode_grad_into(r, self.acc)
+            self.decoded += 1
+            self._cursor += 1
+
+    def finish(self, futs) -> bool:
+        """Drain any settled tail the callbacks have not reached yet (the
+        barrier already awaited every future, but gRPC's callback threads
+        may lag the main thread's own `result()`); returns clean?"""
+        with self._lock:
+            for i, (_key, fut) in enumerate(futs):
+                if i not in self._results:
+                    try:
+                        self._results[i] = (fut.result()
+                                            if fut is not None else None)
+                    except Exception:  # noqa: BLE001
+                        self._results[i] = None
+            self._advance()
+            return not self.dirty
+
+
 class _LatencyEwma:
     """Per-worker Gradient reply-latency EWMA (mean + mean absolute
     deviation) feeding the quorum barrier's adaptive soft deadline
@@ -256,9 +330,22 @@ class _BroadcastState:
 
     SPARSE_BREAK_EVEN = 0.5  # changed fraction above which dense is smaller
 
-    def __init__(self, delta_broadcast: bool, metrics, versioned: bool = False):
+    def __init__(self, delta_broadcast: bool, metrics, versioned: bool = False,
+                 encode_ahead: bool = True):
         self.delta_broadcast = delta_broadcast
         self.metrics = metrics
+        # encode-ahead (ROADMAP item 2): `advance()` hands the new
+        # version's wire forms (full tensor bytes + the np.nonzero sparse
+        # delta) to a single background encoder thread, overlapping the
+        # encode with the window's host-side bookkeeping (fit-state
+        # snapshot, membership check, sample draws) and — under quorum —
+        # with straggler replies still in flight.  `populate` joins the
+        # pending encode before reading, so the wire forms are
+        # byte-identical to the synchronous path; with encode_ahead off
+        # (or before the first advance) encoding stays lazy in populate.
+        self.encode_ahead = bool(encode_ahead)
+        self._enc_pool = None
+        self._enc_future = None
         # `versioned` without delta_broadcast (the quorum barrier's mode):
         # every request still carries the full dense tensor, but stamped
         # with step_version — the workers' EF guard and the quorum
@@ -278,11 +365,41 @@ class _BroadcastState:
         self._delta_msg = None    # pb.WeightDelta, False = dense fallback
 
     def advance(self, w_new: np.ndarray, w_old: np.ndarray) -> None:
-        """Weights moved: bump the version and invalidate encoded forms."""
+        """Weights moved: bump the version, invalidate encoded forms, and
+        (encode_ahead) start encoding the new version off-thread."""
         self.version += 1
         self._w_prev = w_old
         self._full_msg = None
         self._delta_msg = None
+        if not self.encode_ahead:
+            return
+        if self._enc_pool is None:
+            import weakref
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._enc_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="bcast-encode")
+            # the broadcast state is fit-scoped: release the encoder
+            # thread when the fit drops it (every exit path, exceptions
+            # included) without threading a close() through fit_sync
+            weakref.finalize(self, self._enc_pool.shutdown, wait=False)
+        self._enc_future = self._enc_pool.submit(self._preencode, w_new)
+
+    def _preencode(self, w: np.ndarray) -> None:
+        """Encoder-thread body: build the forms `populate` will need —
+        results land in the same lazy slots, `_join_encode` gives the
+        happens-before edge."""
+        full = codec.encode_tensor(w)
+        if self.delta_broadcast:
+            # False ("use the full form") is itself a computed result
+            self._delta_msg = self._compute_delta(w)
+        self._full_msg = full
+
+    def _join_encode(self) -> None:
+        f = self._enc_future
+        if f is not None:
+            f.result()  # surfaces encoder exceptions on the fit thread
+            self._enc_future = None
 
     def note_ok(self, key) -> None:
         self._worker_ver[key] = self.version
@@ -324,24 +441,29 @@ class _BroadcastState:
         metrics_mod.record_broadcast(self.metrics, "full", full.ByteSize())
 
     def _full(self, w: np.ndarray):
+        self._join_encode()
         if self._full_msg is None:
             self._full_msg = codec.encode_tensor(w)
         return self._full_msg
 
+    def _compute_delta(self, w: np.ndarray):
+        """The sparse WeightDelta vs the previous version, or False when a
+        full tensor is the smaller (or only possible) wire form."""
+        if self._w_prev is None:
+            return False
+        changed = np.nonzero(w != self._w_prev)[0]
+        if len(changed) > self.SPARSE_BREAK_EVEN * len(w):
+            return False  # dense-ish: full is smaller
+        return pb.WeightDelta(
+            base_version=self.version - 1,
+            indices=changed.astype(np.int32),
+            values=np.ascontiguousarray(w[changed]),
+        )
+
     def _delta(self, w: np.ndarray):
+        self._join_encode()
         if self._delta_msg is None:
-            if self._w_prev is None:
-                self._delta_msg = False
-            else:
-                changed = np.nonzero(w != self._w_prev)[0]
-                if len(changed) > self.SPARSE_BREAK_EVEN * len(w):
-                    self._delta_msg = False  # dense-ish: full is smaller
-                else:
-                    self._delta_msg = pb.WeightDelta(
-                        base_version=self.version - 1,
-                        indices=changed.astype(np.int32),
-                        values=np.ascontiguousarray(w[changed]),
-                    )
+            self._delta_msg = self._compute_delta(w)
         return self._delta_msg or None
 
 
@@ -1171,6 +1293,17 @@ class MasterNode:
                     futs = []
                     ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
                     rb_sent: Dict[Tuple[str, int], int] = {}
+                    # overlapped fan-in (full barrier only): zero the
+                    # accumulator BEFORE the fan-out so each reply's
+                    # scatter-decode runs in its arrival callback,
+                    # send-ordered — only the slowest reply's decode stays
+                    # on the critical path.  The quorum barrier keeps its
+                    # post-barrier decode: its contributor set (hedge wins,
+                    # late originals) is only known once the round closes.
+                    decoder = None
+                    if quorum is None:
+                        grad_acc.fill(0.0)
+                        decoder = _ArrivalDecoder(grad_acc)
                     for (key, stub), part in zip(members, parts):
                         ids = _draw_ids(rng, part, batch, window_span)
                         ids_by_key[key] = ids
@@ -1190,12 +1323,15 @@ class MasterNode:
                         except ValueError:  # channel closed under us
                             fut = None
                         futs.append((key, fut))
+                        if decoder is not None:
+                            decoder.watch(len(futs) - 1, fut)
                     if quorum is None:
                         # barrier, with deadlines; receive-side wire accounting
                         # happens per arriving reply inside _await_futures (send-
                         # side comms.* counters live in the workers' compressors),
                         # so discarded/retried windows are accounted too
                         ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
+                        decoder.finish(futs)
                         good, stale = [], []
                         for key, reply in ok:
                             (stale if reply.stale_version else good).append((key, reply))
@@ -1270,13 +1406,18 @@ class MasterNode:
                     # allocation-free fan-in: scatter/add every reply into the
                     # preallocated accumulator, then scale once — replaces the
                     # per-window [decode_grad(r) for r in ok] dense stack +
-                    # np.mean (Vec.mean, Master.scala:194).  Under a satisfied
-                    # quorum `replies` holds the actual contributors (own + hedge
-                    # replies) and the mean over |contributors| is the unbiased
-                    # 1/|ok| scaling of Chen et al. 2016's backup-worker rule.
-                    grad_acc.fill(0.0)
-                    for reply in replies:
-                        codec.decode_grad_into(reply, grad_acc)
+                    # np.mean (Vec.mean, Master.scala:194).  The full barrier
+                    # already decoded per arrival (send-ordered, so the sums
+                    # are bit-identical — see _ArrivalDecoder); the quorum
+                    # path decodes here, once the contributor set is known:
+                    # under a satisfied quorum `replies` holds the actual
+                    # contributors (own + hedge replies) and the mean over
+                    # |contributors| is the unbiased 1/|ok| scaling of Chen
+                    # et al. 2016's backup-worker rule.
+                    if decoder is None or decoder.decoded != len(replies):
+                        grad_acc.fill(0.0)
+                        for reply in replies:
+                            codec.decode_grad_into(reply, grad_acc)
                     grad_acc /= len(replies)  # true divide, bit-matching np.mean
                     if health is not None:
                         # NaN/Inf sentinel: a non-finite fan-in NEVER

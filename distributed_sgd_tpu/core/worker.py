@@ -369,7 +369,12 @@ class WorkerNode:
                 by = y[ids] * valid.astype(y.dtype)
                 return model.grad_regularized(w, batch, by, blocked=blocked)
 
-            self._grad_cache[capacity] = jax.jit(fn)
+            # donate the request's weight buffer (ROADMAP item 2): the
+            # wrapper creates it from the wire/replica numpy array per
+            # dispatch and nobody reads it afterwards, so XLA can write
+            # the [D] gradient straight into its HBM instead of
+            # allocating a fresh dim-sized output every window
+            self._grad_cache[capacity] = jax.jit(fn, donate_argnums=(0,))
         return self._grad_cache[capacity]
 
     def _blocked_device(self) -> bool:
@@ -469,7 +474,10 @@ class WorkerNode:
                 w_end, _ = jax.lax.scan(body, w, (ids, valid))
                 return w - w_end
 
-            self._grad_cache[key] = jax.jit(fn)
+            # w is request-scoped here too (see _grad_fn): donating it
+            # lets the K-step scan run in place and the summed decrement
+            # reuse the buffer — no per-window HBM copy
+            self._grad_cache[key] = jax.jit(fn, donate_argnums=(0,))
         return self._grad_cache[key]
 
     def compute_local_window(self, w: np.ndarray, ids: np.ndarray, k: int,
@@ -708,7 +716,10 @@ class WorkerNode:
                 body, (w, opt_state, jnp.zeros_like(w)), keys)
             return acc, opt_state
 
-        kstep = jax.jit(kstep)
+        # donate the local optimizer state (threaded carry, rebound every
+        # dispatch; the weight SNAPSHOT must not be donated — a concurrent
+        # UpdateGrad may still read the same buffer through self._w)
+        kstep = jax.jit(kstep, donate_argnums=(1,))
         key = jax.random.PRNGKey(self.seed + self.port)
         opt_state = opt.init(self._w) if opt is not None else None
         while self._running_async.is_set():

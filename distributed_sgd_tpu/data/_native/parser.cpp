@@ -22,6 +22,48 @@
 
 namespace {
 
+// Floating-point std::from_chars needs libstdc++ >= 11 (__cpp_lib_to_chars);
+// older toolchains (this image ships GCC 10) only have the integer
+// overloads.  The shim below reproduces the from_chars contract the parser
+// relies on — single token, NO leading whitespace, NO leading '+', ptr
+// advanced past exactly the consumed characters, ptr == first on failure —
+// on top of strtof (bounded copy; number tokens in these files are short).
+#if defined(__cpp_lib_to_chars)
+inline const char* parse_float(const char* first, const char* last, float& v) {
+  auto r = std::from_chars(first, last, v, std::chars_format::general);
+  return r.ptr;
+}
+#else
+#include <locale.h>
+inline const char* parse_float(const char* first, const char* last, float& v) {
+  if (first >= last) return first;
+  // from_chars parity: strtof would skip whitespace and accept a leading
+  // '+'/"inf"/"nan"/hex — reject everything a LIBSVM value can't start with
+  const char c = *first;
+  if (!((c >= '0' && c <= '9') || c == '-' || c == '.')) return first;
+  char buf[64];
+  size_t n = static_cast<size_t>(last - first);
+  if (n > sizeof(buf) - 1) n = sizeof(buf) - 1;
+  memcpy(buf, first, n);
+  buf[n] = '\0';
+  // from_chars parity, continued: strtof reads "0x10" as hex (from_chars
+  // general format stops after the "0") — truncate at the 'x' so both
+  // build paths advance identically
+  size_t digit0 = (buf[0] == '-') ? 1 : 0;
+  if (buf[digit0] == '0' && (buf[digit0 + 1] == 'x' || buf[digit0 + 1] == 'X'))
+    buf[digit0 + 1] = '\0';
+  // strtof is locale-dependent (a de_DE LC_NUMERIC expects ',' and would
+  // truncate "3.14" to 3.0); parse under an explicit "C" locale so an
+  // embedding process's setlocale() cannot corrupt the data path
+  static locale_t c_loc = newlocale(LC_ALL_MASK, "C", (locale_t)0);
+  char* endp = nullptr;
+  float out = strtof_l(buf, &endp, c_loc);
+  if (endp == buf) return first;
+  v = out;
+  return first + (endp - buf);
+}
+#endif
+
 struct ChunkOut {
   std::vector<int32_t> doc_ids;
   std::vector<int64_t> row_nnz;
@@ -74,12 +116,12 @@ void parse_chunk(const char* begin, const char* end, int32_t index_offset,
         ++p;
         if (p < end && *p == '+') ++p;
         float v = 0.0f;
-        auto rv = std::from_chars(p, end, v, std::chars_format::general);
-        if (rv.ptr == p) {  // malformed value; drop token
+        const char* rv = parse_float(p, end, v);
+        if (rv == p) {  // malformed value; drop token
           while (p < end && *p != ' ' && *p != '\n') ++p;
           continue;
         }
-        p = rv.ptr;
+        p = rv;
         out->col_idx.push_back(static_cast<int32_t>(feat) + index_offset);
         out->values.push_back(v);
         ++nnz;

@@ -41,6 +41,22 @@ def initialize(
 ) -> None:
     """jax.distributed.initialize with env fallback (JAX_COORDINATOR_ADDRESS
     etc. are honored when args are None)."""
+    import os
+
+    platforms = str(jax.config.jax_platforms or
+                    os.environ.get("JAX_PLATFORMS", ""))
+    if "cpu" in platforms:
+        # the CPU backend has no built-in cross-process collectives
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"): select the gloo transport BEFORE the backend
+        # initializes, so the 2-process CPU validation
+        # (tests/test_multihost_2proc.py) runs the same global-mesh code
+        # path real TPU pods do.  Probed on this jaxlib; guarded so a
+        # build without gloo still reaches the TPU path untouched.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception as e:  # noqa: BLE001 - older/newer jaxlib surface
+            log.warning("could not select gloo CPU collectives: %s", e)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

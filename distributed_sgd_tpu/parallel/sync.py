@@ -99,6 +99,8 @@ class BoundSync:
         virtual_workers: int = 1,
         optimizer=None,
         momentum: float = 0.9,
+        scatter: Optional[str] = None,
+        donate: bool = False,
     ):
         if sampling not in ("fresh", "epoch"):
             raise ValueError(f"sampling must be 'fresh' or 'epoch', got {sampling!r}")
@@ -120,6 +122,24 @@ class BoundSync:
         # trips a lowering-cache KeyError inside jax (observed on jax 0.8)
         self._pallas_interpret = jax.default_backend() != "tpu"
         self._check_vma = kernel != "pallas"
+        # scatter formulation override (ops/mxu.py, DSGD_SCATTER): None
+        # inherits the process-wide selection; a name pins THIS engine's
+        # compiled programs to it (applied as a trace-time scope around
+        # each body, so two engines with different formulations coexist —
+        # the fused A/B harness builds them side by side)
+        if scatter is not None and scatter not in mxu.SCATTER_FORMULATIONS:
+            raise ValueError(
+                f"scatter must be one of {mxu.SCATTER_FORMULATIONS} or None "
+                f"(process default), got {scatter!r}")
+        self._scatter = scatter
+        # buffer donation (ROADMAP item 2): donate=True marks the weights
+        # and optimizer-state arguments of the TRAINING dispatches (step /
+        # epoch / fused multi-epoch) as donated, so XLA reuses their HBM
+        # for the outputs instead of allocating fresh buffers per call.
+        # Bit-exact, but it consumes the caller's arrays: re-using a
+        # donated input faults (tests/test_donation.py) — hence opt-in.
+        # Eval/predict never donate (weights are read-only there).
+        self._donate = (0, 1) if donate else ()
         self.model = model
         self.mesh = mesh
         self.data = data
@@ -162,21 +182,23 @@ class BoundSync:
         dspec = (P(AXIS), P(AXIS), P(AXIS))
         self._epoch = jax.jit(
             shard_map(
-                self._epoch_shard,
+                self._scoped(self._epoch_shard),
                 mesh=mesh,
                 in_specs=(P(), sspec) + dspec + (P(),),
                 out_specs=(P(), sspec),
                 check_vma=self._check_vma,
-            )
+            ),
+            donate_argnums=self._donate,
         )
         self._step = jax.jit(
             shard_map(
-                self._step_shard,
+                self._scoped(self._step_shard),
                 mesh=mesh,
                 in_specs=(P(), sspec) + dspec + (P(),),
                 out_specs=(P(), sspec),
                 check_vma=self._check_vma,
-            )
+            ),
+            donate_argnums=self._donate,
         )
         self._sspec = sspec
         self._eval = jax.jit(
@@ -199,6 +221,21 @@ class BoundSync:
         )
 
     # -- per-device bodies (run under shard_map) ---------------------------
+
+    def _scoped(self, fn):
+        """Wrap a shard body so TRACING runs under this engine's scatter
+        formulation (dispatch happens at trace time; see ops/mxu.py).
+        None = inherit the process-wide selection unwrapped."""
+        if self._scatter is None:
+            return fn
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args):
+            with mxu.scatter_formulation(self._scatter):
+                return fn(*args)
+
+        return wrapped
 
     def _subshards(self):
         """(sub, starts, sizes): the per-virtual-worker ceil-split of this
@@ -442,12 +479,14 @@ class BoundSync:
 
             self._multi_cache[n_epochs] = jax.jit(
                 shard_map(
-                    functools.partial(self._multi_epoch_shard, n_epochs),
+                    self._scoped(
+                        functools.partial(self._multi_epoch_shard, n_epochs)),
                     mesh=self.mesh,
                     in_specs=(P(), self._sspec) + (P(AXIS), P(AXIS), P(AXIS)) + (P(),),
                     out_specs=(P(), self._sspec),
                     check_vma=self._check_vma,
-                )
+                ),
+                donate_argnums=self._donate,
             )
         w, self._opt_state = self._multi_cache[n_epochs](
             w, self._opt_state, self.data.indices, self.data.values,
@@ -555,6 +594,8 @@ class SyncEngine:
         virtual_workers: int = 1,
         optimizer=None,
         momentum: float = 0.9,
+        scatter: Optional[str] = None,
+        donate: bool = False,
     ):
         self.model = model
         self.mesh = mesh
@@ -566,6 +607,8 @@ class SyncEngine:
         self.virtual_workers = virtual_workers
         self.optimizer = optimizer
         self.momentum = momentum
+        self.scatter = scatter
+        self.donate = donate
 
     def bind(self, data: Dataset, steps_per_epoch: Optional[int] = None) -> BoundSync:
         n_workers = self.mesh.shape[AXIS]
@@ -622,6 +665,8 @@ class SyncEngine:
             virtual_workers=self.virtual_workers,
             optimizer=self.optimizer,
             momentum=self.momentum,
+            scatter=self.scatter,
+            donate=self.donate,
         )
 
 

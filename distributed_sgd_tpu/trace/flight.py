@@ -37,10 +37,13 @@ from typing import List, Optional
 log = logging.getLogger("dsgd.flight")
 
 DEFAULT_CAPACITY = 512
-# where un-configured recorders dump (next to the process, the classic
-# black-box location); overridable process-wide so embedding harnesses —
-# tests/conftest.py does — can redirect evidence away from their CWD
-DEFAULT_DIR = "."
+# where un-configured recorders dump: DSGD_TRACE_DIR when the environment
+# names one (so subprocess children — test workers, bench fits — inherit
+# the redirect without running any configure() of their own), else next to
+# the process, the classic black-box location.  Also overridable
+# process-wide (tests/conftest.py does both) so harnesses keep evidence
+# out of their CWD.
+DEFAULT_DIR = os.environ.get("DSGD_TRACE_DIR") or "."
 
 
 class FlightRecorder:

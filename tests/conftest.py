@@ -20,12 +20,18 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
-# Flight-recorder dumps (trace/flight.py) default to the process CWD —
-# the black-box location a production crash should use — but under
-# pytest that is the repo root: redirect the session default to a temp
-# dir so eviction/crash tests don't litter the working tree.
+# Flight-recorder dumps (trace/flight.py) default to DSGD_TRACE_DIR, or
+# the process CWD — the black-box location a production crash should use —
+# but under pytest that is the repo root: redirect the session default to
+# a temp dir so eviction/crash tests don't litter the working tree.  The
+# env var (not just the module attribute) is what SUBPROCESS children —
+# multiproc/CLI tests, canary-rollback fits — inherit; without it their
+# un-configured recorders dumped flight-*.json into the checkout.
 import tempfile  # noqa: E402
+
+_flight_dir = os.environ.setdefault(
+    "DSGD_TRACE_DIR", tempfile.mkdtemp(prefix="dsgd-test-flight-"))
 
 from distributed_sgd_tpu.trace import flight as _flight  # noqa: E402
 
-_flight.DEFAULT_DIR = tempfile.mkdtemp(prefix="dsgd-test-flight-")
+_flight.DEFAULT_DIR = _flight_dir

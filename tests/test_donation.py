@@ -1,0 +1,167 @@
+"""Buffer donation on the training dispatches (ROADMAP item 2).
+
+Donation is bit-exact but changes BUFFER semantics: a donated input's
+memory is handed to XLA for the outputs, so the array is deleted and any
+re-use must fault.  These tests pin both sides of the contract:
+
+- the mesh engine's jitted step / epoch / fused multi-epoch donate the
+  weights + optimizer-state arguments when built with ``donate=True``
+  (opt-in: callers of the default engine may re-use their ``w0``);
+- the RPC worker's Gradient / local-window kernels ALWAYS donate the
+  request's weight buffer (it is created from the wire bytes per dispatch
+  — nobody can legally re-use it);
+- the default engine stays donation-free: re-using ``w0`` keeps working,
+  and donate=True produces bit-identical numbers to donate=False.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import SparseSVM
+from distributed_sgd_tpu.parallel.mesh import make_mesh
+from distributed_sgd_tpu.parallel.sync import SyncEngine
+
+D = 300
+
+
+def _bound(donate: bool, d: int = D):
+    data = rcv1_like(64, n_features=d, nnz=8, seed=3)
+    model = SparseSVM(lam=1e-3, n_features=d,
+                      dim_sparsity=jnp.asarray(np.full(d, 0.01, np.float32)))
+    eng = SyncEngine(model, make_mesh(1), batch_size=4, learning_rate=0.3,
+                     virtual_workers=2, donate=donate)
+    return eng.bind(data)
+
+
+def test_donated_step_consumes_weights_and_reuse_faults():
+    bound = _bound(donate=True)
+    key = jax.random.PRNGKey(0)
+    w0 = jnp.zeros(D, jnp.float32)
+    w1 = bound.step(w0, key)
+    assert w0.is_deleted(), "donate=True must hand the weight buffer to XLA"
+    assert np.all(np.isfinite(np.asarray(w1)))
+    with pytest.raises(Exception, match="[Dd]elet|[Dd]onat"):
+        bound.step(w0, key)  # re-using a donated input must fault
+
+
+def test_donated_epoch_and_multi_epoch_consume_weights():
+    bound = _bound(donate=True)
+    key = jax.random.PRNGKey(1)
+    w0 = jnp.zeros(D, jnp.float32)
+    w1 = bound.epoch(w0, key)
+    assert w0.is_deleted()
+    w2 = bound.multi_epoch(w1, key, 2)
+    assert w1.is_deleted()
+    assert np.all(np.isfinite(np.asarray(w2)))
+
+
+def test_donation_is_bit_exact_and_default_off():
+    key = jax.random.PRNGKey(2)
+    # default engine: no donation — the caller may re-use w0 (the headline
+    # bench's slope-fit protocol does exactly this)
+    plain = _bound(donate=False)
+    w0 = jnp.zeros(D, jnp.float32)
+    a = np.asarray(plain.epoch(w0, key))
+    b = np.asarray(plain.epoch(w0, key))  # re-use must NOT fault
+    assert not w0.is_deleted()
+    np.testing.assert_array_equal(a, b)
+    # donate=True computes the identical update
+    donated = _bound(donate=True)
+    c = np.asarray(donated.epoch(jnp.zeros(D, jnp.float32), key))
+    np.testing.assert_array_equal(a, c)
+
+
+def test_donated_opt_state_threads_through():
+    data = rcv1_like(64, n_features=D, nnz=8, seed=3)
+    model = SparseSVM(lam=1e-3, n_features=D,
+                      dim_sparsity=jnp.asarray(np.full(D, 0.01, np.float32)))
+    eng = SyncEngine(model, make_mesh(1), batch_size=4, learning_rate=0.3,
+                     optimizer="momentum", donate=True)
+    bound = eng.bind(data)
+    key = jax.random.PRNGKey(3)
+    leaves0 = bound.opt_state_leaves()
+    w = bound.step(jnp.zeros(D, jnp.float32), key)
+    # the old optimizer-state buffers were donated; the engine now holds
+    # fresh ones and the momentum buffer moved
+    assert all(x.is_deleted() for x in leaves0 if hasattr(x, "is_deleted"))
+    assert any(np.any(np.asarray(x) != 0) for x in bound.opt_state_leaves())
+    w2 = bound.step(w, key)
+    assert np.all(np.isfinite(np.asarray(w2)))
+
+
+class _FakeWorkerHost:
+    """The minimum surface WorkerNode._grad_fn/_window_fn need."""
+
+
+def test_worker_grad_fn_donates_request_weights():
+    # build the worker's jitted kernels directly (no cluster): the weight
+    # argument is request-scoped and must be donated unconditionally
+    from distributed_sgd_tpu.core.worker import WorkerNode
+
+    data = rcv1_like(32, n_features=D, nnz=6, seed=1)
+    model = SparseSVM(lam=1e-3, n_features=D,
+                      dim_sparsity=jnp.asarray(np.full(D, 0.01, np.float32)))
+    grad_fn = WorkerNode._grad_fn.__wrapped__ if hasattr(
+        WorkerNode._grad_fn, "__wrapped__") else WorkerNode._grad_fn
+    host = _FakeWorkerHost()
+    host.model = model
+    host._grad_cache = {}
+    host._blocked_device = lambda: False
+    fn = grad_fn(host, 8)
+    idx = jnp.asarray(data.indices)
+    val = jnp.asarray(data.values)
+    y = jnp.asarray(data.labels)
+    ids = jnp.zeros(8, jnp.int32)
+    valid = jnp.ones(8, jnp.float32)
+    w = jnp.zeros(D, jnp.float32)
+    g = fn(w, idx, val, y, ids, valid)
+    assert w.is_deleted(), "worker Gradient kernel must donate the weights"
+    # the resident dataset must NOT be donated — it serves every request
+    assert not idx.is_deleted() and not val.is_deleted()
+    assert np.all(np.isfinite(np.asarray(g)))
+    win_fn = WorkerNode._window_fn.__wrapped__ if hasattr(
+        WorkerNode._window_fn, "__wrapped__") else WorkerNode._window_fn
+    fn2 = win_fn(host, 2, 4)
+    w = jnp.zeros(D, jnp.float32)
+    delta = fn2(w, idx, val, y, jnp.zeros((2, 4), jnp.int32),
+                jnp.ones((2, 4), jnp.float32), jnp.float32(0.3))
+    assert w.is_deleted(), "local-window kernel must donate the weights"
+    assert not idx.is_deleted()
+    assert np.all(np.isfinite(np.asarray(delta)))
+
+
+def test_worker_compute_gradient_end_to_end_still_works():
+    """Donation must be invisible at the RPC surface: repeated
+    compute_gradient calls with the same HOST numpy weights (each call
+    builds a fresh device buffer) keep returning identical gradients."""
+    from distributed_sgd_tpu.data.rcv1 import Dataset
+    from distributed_sgd_tpu.core.worker import WorkerNode
+    from distributed_sgd_tpu.core.master import MasterNode
+
+    d = D
+    data = rcv1_like(48, n_features=d, nnz=6, seed=2)
+    model = SparseSVM(lam=1e-3, n_features=d,
+                      dim_sparsity=jnp.asarray(np.full(d, 0.01, np.float32)))
+    master = MasterNode("127.0.0.1", 0, data, data, model,
+                        expected_workers=1, seed=0).start(heartbeat_s=None)
+    try:
+        worker = WorkerNode("127.0.0.1", 0, master.host, master.port,
+                            data, model, seed=0).start()
+        try:
+            w_np = np.random.default_rng(4).normal(size=d).astype(np.float32)
+            ids = np.arange(10)
+            g1 = worker.compute_gradient(w_np, ids)
+            g2 = worker.compute_gradient(w_np, ids)
+            np.testing.assert_array_equal(g1, g2)
+            dlt = worker.compute_local_window(w_np, np.arange(16), k=2,
+                                              batch_size=8, learning_rate=0.3)
+            dlt2 = worker.compute_local_window(w_np, np.arange(16), k=2,
+                                               batch_size=8, learning_rate=0.3)
+            np.testing.assert_array_equal(dlt, dlt2)
+        finally:
+            worker.stop()
+    finally:
+        master.stop()

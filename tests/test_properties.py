@@ -3,7 +3,17 @@ packer, and the blocked-layout transforms — the seams where a shape or
 rounding bug would silently corrupt data rather than crash."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# capability probe: hypothesis is not baked into every image this suite
+# runs on (no-egress environments cannot pip install it) — skip the module
+# cleanly instead of erroring collection (the "1 collection error" the
+# PR 7/8 tier-1 notes documented; see CHANGES.md)
+hypothesis = pytest.importorskip(
+    "hypothesis",
+    reason="hypothesis not installed in this image (no-egress; the "
+    "property suite runs wherever it is available)")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from distributed_sgd_tpu.rpc import codec
 

@@ -60,16 +60,21 @@ def oracle_step(w: dict, rows, ys, ids_per_worker, ds: dict):
     return out
 
 
-@pytest.mark.parametrize("kernel", [
-    "scalar", "mxu",
-    pytest.param("pallas", marks=pytest.mark.skipif(
+@pytest.mark.parametrize("kernel,scatter", [
+    ("scalar", None), ("mxu", None),
+    # every selectable scatter formulation (ops/mxu.py DSGD_SCATTER) must
+    # land on the boxed-map numbers too — 'bf16' within its documented
+    # accumulation bound, the exact formulations within float-order noise
+    ("mxu", "onehot"), ("mxu", "segment"), ("mxu", "twostage"),
+    ("mxu", "bf16"),
+    pytest.param("pallas", None, marks=pytest.mark.skipif(
         os.environ.get("DSGD_PALLAS", "") != "1"
         and not pallas_sparse.pallas_supported(),
         reason="pallas kernel unsupported on this jax (pallas_supported() "
         "probe failed) and DSGD_PALLAS=1 not set; measured-rejection "
         "record in BASELINE.md / ROADMAP item 2")),
 ])
-def test_engine_matches_boxed_map_oracle(kernel):
+def test_engine_matches_boxed_map_oracle(kernel, scatter):
     data = rcv1_like(64, n_features=D, nnz=8, seed=3)
     rows = _sparse_rows(data)
     ys = [int(y) for y in np.asarray(data.labels)]
@@ -80,7 +85,7 @@ def test_engine_matches_boxed_map_oracle(kernel):
     model = SparseSVM(lam=LAM, n_features=D, dim_sparsity=jnp.asarray(ds_vec))
     mesh = make_mesh(1)
     eng = SyncEngine(model, mesh, batch_size=B, learning_rate=LR,
-                     kernel=kernel, virtual_workers=K)
+                     kernel=kernel, virtual_workers=K, scatter=scatter)
     bound = eng.bind(data)
 
     w_np = (rng.normal(size=D) * 0.1).astype(np.float32)
@@ -100,7 +105,16 @@ def test_engine_matches_boxed_map_oracle(kernel):
     for k, v in w1.items():
         want[k] = v
 
-    np.testing.assert_allclose(got, want.astype(np.float32), rtol=2e-4, atol=2e-6)
+    if scatter == "bf16":
+        # one step's update error is bounded by lr * the bf16 scatter
+        # bound over a B=6 backward sum — loose vs the exact paths, tight
+        # vs any actual formulation bug (tests/test_kernel_edge_shapes.py
+        # pins the kernel-level bound)
+        np.testing.assert_allclose(got, want.astype(np.float32),
+                                   rtol=2e-2, atol=2e-3)
+    else:
+        np.testing.assert_allclose(got, want.astype(np.float32),
+                                   rtol=2e-4, atol=2e-6)
 
 
 def test_oracle_objective_matches_model():

@@ -431,3 +431,185 @@ def test_await_futures_accounts_bytes_even_on_failed_windows():
     assert counter.value == reply.ByteSize(), (
         "the arriving reply's bytes must be counted even though the "
         "window will be retried")
+
+
+# -- overlapped fan-in: decode-on-arrival + encode-ahead (ROADMAP item 2) --
+
+class _SettleLaterFut:
+    """A gRPC-future stand-in whose callback fires when .settle() is
+    called — lets the tests drive arbitrary arrival orders."""
+
+    def __init__(self):
+        self._cbs = []
+        self._result = None
+        self._exc = None
+        self._done = False
+
+    def add_done_callback(self, cb):
+        if self._done:
+            cb(self)
+        else:
+            self._cbs.append(cb)
+
+    def settle(self, result=None, exc=None):
+        self._result, self._exc, self._done = result, exc, True
+        for cb in self._cbs:
+            cb(self)
+
+    def result(self):
+        if not self._done:
+            raise AssertionError("result() before settle()")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+def _grad_msg(vec):
+    return codec.encode_grad(np.asarray(vec, dtype=np.float32))
+
+
+def test_arrival_decoder_out_of_order_matches_send_order_sums():
+    """Replies settling out of order must decode in SEND order — the float
+    accumulation the post-barrier loop would have produced, bit for bit."""
+    from distributed_sgd_tpu.core.master import _ArrivalDecoder
+
+    vecs = [np.random.default_rng(i).normal(size=64).astype(np.float32)
+            for i in range(4)]
+    acc = np.zeros(64, dtype=np.float32)
+    dec = _ArrivalDecoder(acc)
+    futs = [(("w", i), _SettleLaterFut()) for i in range(4)]
+    for i, (_k, f) in enumerate(futs):
+        dec.watch(i, f)
+    # settle in reverse order: nothing can decode until index 0 lands
+    futs[3][1].settle(_grad_msg(vecs[3]))
+    futs[2][1].settle(_grad_msg(vecs[2]))
+    assert dec.decoded == 0
+    futs[0][1].settle(_grad_msg(vecs[0]))
+    assert dec.decoded == 1  # only the contiguous prefix {0} may decode
+    futs[1][1].settle(_grad_msg(vecs[1]))
+    assert dec.decoded == 4  # 1 landed -> the settled tail 2, 3 follows
+    assert dec.finish(futs)
+    want = np.zeros(64, dtype=np.float32)
+    for v in vecs:  # send order, exactly like the old post-barrier loop
+        want += v
+    np.testing.assert_array_equal(acc, want)
+
+
+def test_arrival_decoder_failure_and_stale_freeze_the_window():
+    from distributed_sgd_tpu.core.master import _ArrivalDecoder
+
+    acc = np.zeros(8, dtype=np.float32)
+    dec = _ArrivalDecoder(acc)
+    futs = [(("w", i), _SettleLaterFut()) for i in range(3)]
+    for i, (_k, f) in enumerate(futs):
+        dec.watch(i, f)
+    futs[0][1].settle(_grad_msg(np.ones(8)))
+    futs[1][1].settle(exc=RuntimeError("deadline"))
+    futs[2][1].settle(_grad_msg(2 * np.ones(8)))
+    assert not dec.finish(futs)  # dirty: the caller retries the window
+    # the failed slot froze the cursor — slot 2 must NOT have decoded
+    assert dec.decoded == 1
+    # a stale reply freezes the same way
+    acc2 = np.zeros(8, dtype=np.float32)
+    dec2 = _ArrivalDecoder(acc2)
+    futs2 = [(("w", 0), _SettleLaterFut()), (("w", 1), _SettleLaterFut())]
+    for i, (_k, f) in enumerate(futs2):
+        dec2.watch(i, f)
+    futs2[0][1].settle(pb.GradUpdate(stale_version=True))
+    futs2[1][1].settle(_grad_msg(np.ones(8)))
+    assert not dec2.finish(futs2)
+    assert dec2.decoded == 0
+    np.testing.assert_array_equal(acc2, np.zeros(8, dtype=np.float32))
+
+
+def test_arrival_decoder_finish_drains_lagging_callbacks():
+    """gRPC may run callbacks AFTER the barrier's own result() returns:
+    finish() must decode the settled tail itself, and a late callback
+    must not decode the same reply twice (set-once per index)."""
+    from distributed_sgd_tpu.core.master import _ArrivalDecoder
+
+    class _NoCallbackFut(_SettleLaterFut):
+        def add_done_callback(self, cb):
+            self._late_cb = cb  # hold it back, like a lagging executor
+
+    acc = np.zeros(4, dtype=np.float32)
+    dec = _ArrivalDecoder(acc)
+    fut = _NoCallbackFut()
+    dec.watch(0, fut)
+    fut.settle(_grad_msg([1, 2, 3, 4]))
+    assert dec.decoded == 0  # callback never ran
+    assert dec.finish([(("w", 0), fut)])
+    np.testing.assert_array_equal(acc, [1, 2, 3, 4])
+    fut._late_cb(fut)  # the lagging callback finally fires
+    np.testing.assert_array_equal(acc, [1, 2, 3, 4])  # no double decode
+
+
+def test_encode_ahead_forms_match_synchronous_encode():
+    """_BroadcastState.advance() hands encoding to the background thread;
+    the forms populate() reads must be byte-identical to the synchronous
+    path, full and delta alike."""
+    from distributed_sgd_tpu.core.master import _BroadcastState
+
+    rng = np.random.default_rng(0)
+    w0 = rng.normal(size=256).astype(np.float32)
+    w1 = w0.copy()
+    w1[[3, 77, 200]] += 1.0
+
+    def _forms(encode_ahead):
+        bs = _BroadcastState(True, mm.Metrics(), encode_ahead=encode_ahead)
+        bs.note_ok(("w", 1))  # acknowledge v1 so v2 offers the delta
+        bs.advance(w1, w0)
+        req = pb.GradientRequest()
+        bs.populate(req, ("w", 1), w1)  # delta arm (one version behind)
+        req_full = pb.GradientRequest()
+        bs.populate(req_full, ("new", 2), w1)  # full arm (unknown worker)
+        return req.SerializeToString(), req_full.SerializeToString()
+
+    d_sync, f_sync = _forms(encode_ahead=False)
+    d_ahead, f_ahead = _forms(encode_ahead=True)
+    assert d_sync == d_ahead
+    assert f_sync == f_ahead
+
+
+def test_overlapped_fanin_fit_matches_post_barrier_decode(data, model_fn):
+    """End to end: a knobs-off 2-worker sync fit through the overlapped
+    fan-in must (a) actually decode every reply on arrival — asserted via
+    a spy decoder, with the post-barrier fallback never taken — and (b)
+    produce weights IDENTICAL to the same fit with arrival decoding
+    disabled (spy decodes nothing, forcing the fallback loop), proving
+    the send-ordered arrival path is bit-exact against the old decode."""
+    from distributed_sgd_tpu.core import master as master_mod
+
+    train, test = data
+    stats = {"decoded": 0, "windows": 0}
+
+    class _SpyDecoder(master_mod._ArrivalDecoder):
+        def finish(self, futs):
+            clean = super().finish(futs)
+            stats["decoded"] += self.decoded
+            stats["windows"] += 1
+            return clean
+
+    class _InertDecoder(master_mod._ArrivalDecoder):
+        def watch(self, i, fut):
+            pass  # never decodes: fit_sync must take the fallback loop
+
+        def finish(self, futs):
+            return True
+
+    orig = master_mod._ArrivalDecoder
+    runs = {}
+    for name, cls in (("arrival", _SpyDecoder), ("fallback", _InertDecoder)):
+        master_mod._ArrivalDecoder = cls
+        try:
+            with DevCluster(model_fn(), train, test, n_workers=2, seed=5) as c:
+                res = _fit(c, max_epochs=2)
+                runs[name] = np.asarray(res.state.weights)
+        finally:
+            master_mod._ArrivalDecoder = orig
+    assert stats["windows"] > 0
+    assert stats["decoded"] == 2 * stats["windows"], (
+        "every window's 2 replies must decode on arrival "
+        f"(decoded {stats['decoded']} over {stats['windows']} windows)")
+    np.testing.assert_array_equal(runs["arrival"], runs["fallback"])
+    assert np.any(runs["arrival"] != 0)
