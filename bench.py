@@ -356,6 +356,16 @@ def main() -> None:
 
         bench_elastic.main(smoke="--smoke" in sys.argv)
         return
+    if "--hier" in sys.argv:
+        # hierarchical multi-host gate (docs/HIERARCHY.md): knobs-off
+        # identity, hierarchical-vs-flat loss parity at equal global
+        # batch, and >= 2x per-round throughput over 1-device-per-worker
+        # at equal device count on the 8-virtual-device harness.
+        # --smoke is the CI-sized asserting mode.
+        from benches import bench_hier
+
+        bench_hier.main(smoke="--smoke" in sys.argv)
+        return
     if "--chaos" in sys.argv:
         # chaos gate (docs/FAULT_TOLERANCE.md): sync training under the
         # canonical seeded fault plan, quorum on vs off — asserts

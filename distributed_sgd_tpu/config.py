@@ -181,6 +181,14 @@ class Config:
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
     feature_shards: int = 1
+    # hierarchical multi-host training (docs/HIERARCHY.md, engine=rpc):
+    # each RPC worker becomes a D-device host — Gradient/local-window
+    # batches shard over a local mesh and reduce with one in-host psum,
+    # so the cross-host plane (delta broadcasts, compression, quorum)
+    # runs per HOST instead of per device.  1 (default) = the flat
+    # single-device worker, byte-identical wire and weights; 0 = auto
+    # (jax.local_device_count(), resolved at role start-up).
+    host_devices: int = 1
 
     # -- serving role (serving/; docs/SERVING.md) --------------------------
     # DSGD_ROLE overrides the master_host/master_port-derived role below;
@@ -269,6 +277,10 @@ class Config:
             raise ValueError("compress_k must be > 0 (fraction of dim or count)")
         if self.feature_shards < 1:
             raise ValueError("feature_shards must be >= 1")
+        if self.host_devices < 0:
+            raise ValueError(
+                "host_devices must be >= 0 (0 = auto from "
+                "jax.local_device_count(); 1 = flat single-device worker)")
         if self.feature_shards > 1 and self.use_async:
             raise ValueError(
                 "feature_shards is a sync (2-D mesh) engine; it cannot be "
@@ -386,6 +398,7 @@ class Config:
             local_steps=_env("DSGD_LOCAL_STEPS", cls.local_steps, int),
             delta_broadcast=_env("DSGD_DELTA_BROADCAST", cls.delta_broadcast, bool),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
+            host_devices=_env("DSGD_HOST_DEVICES", cls.host_devices, int),
             role_override=_env("DSGD_ROLE", None, str),
             serve_port=_env("DSGD_SERVE_PORT", cls.serve_port, int),
             serve_max_batch=_env("DSGD_SERVE_MAX_BATCH", cls.serve_max_batch, int),

@@ -44,7 +44,19 @@ class DevCluster:
         gossip_topology: str = "all",
         master_watch_s: Optional[float] = None,
         telemetry_port: Optional[int] = None,
+        host_devices: int = 1,
+        host_local: bool = False,
     ):
+        """`host_devices > 1` builds a HIERARCHICAL cluster
+        (docs/HIERARCHY.md): each worker is a multi-device host — a
+        contiguous group of `host_devices` devices backing one in-host
+        psum mesh (parallel/hier.py) — so the cluster needs
+        n_workers x host_devices devices.  `host_local=True` additionally
+        gives each worker ONLY its contiguous slice of the corpus
+        (data/host_shard.py host_slice + WorkerNode data_offset), the
+        no-host-materializes-the-corpus loading discipline; it requires
+        the master's default vanilla split (which DevCluster uses) and a
+        topology without mid-fit membership churn."""
         # fault injection (chaos/, DSGD_CHAOS): the plan must be installed
         # BEFORE any node opens a channel so every stub is wrapped — but it
         # stays un-armed through cluster formation (registration and peer
@@ -92,11 +104,25 @@ class DevCluster:
             from distributed_sgd_tpu import chaos as chaos_mod
 
             chaos_mod.name_endpoint(host, self.master.port, "master")
+        # hierarchical topology (docs/HIERARCHY.md): contiguous device
+        # groups + optional host-local data slices per worker
+        self._host_devices = max(1, int(host_devices))
+        groups = None
+        if self._host_devices > 1:
+            from distributed_sgd_tpu.parallel.mesh import local_device_groups
+
+            groups = local_device_groups(devs, n_workers, self._host_devices)
         self.workers: List[WorkerNode] = []
         for i in range(n_workers):
             port = 0 if base_port == 0 else base_port + 1 + i
+            wdata, offset = train, None
+            if host_local:
+                from distributed_sgd_tpu.data.host_shard import host_slice
+
+                start, end = host_slice(len(train), i, n_workers)
+                wdata, offset = train.slice(slice(start, end)), start
             w = WorkerNode(
-                host, port, host, self.master.port, train, model,
+                host, port, host, self.master.port, wdata, model,
                 device=devs[i % len(devs)], seed=seed + i,
                 metrics=node_metrics(),
                 steps_per_dispatch=steps_per_dispatch,
@@ -105,6 +131,9 @@ class DevCluster:
                 gossip_topology=gossip_topology,
                 master_watch_s=master_watch_s,
                 telemetry=self._telemetry,
+                host_devices=self._host_devices,
+                devices=groups[i] if groups is not None else None,
+                data_offset=offset,
             )
             self.workers.append(w)
             if self._chaos_installed:

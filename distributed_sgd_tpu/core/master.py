@@ -38,7 +38,7 @@ from distributed_sgd_tpu.checkpoint import (
 from distributed_sgd_tpu.core.early_stopping import Criterion
 from distributed_sgd_tpu.core.grad_state import GradState
 from distributed_sgd_tpu.core.loss_check import LossChecker, async_fit_result
-from distributed_sgd_tpu.core.split import vanilla_split
+from distributed_sgd_tpu.core.split import vanilla_split, weighted_split
 from distributed_sgd_tpu.core.trainer import FitResult, record_epoch
 from distributed_sgd_tpu.data.rcv1 import Dataset
 from distributed_sgd_tpu.models.linear import LinearModel
@@ -502,6 +502,10 @@ class MasterNode:
         self._workers: Dict[Tuple[str, int], WorkerStub] = {}
         self._channels: Dict[Tuple[str, int], grpc.Channel] = {}
         self._order: List[Tuple[str, int]] = []  # registration order
+        # host shapes (docs/HIERARCHY.md): local device count each worker
+        # reported at registration (Node.devices; 0/absent = flat single-
+        # device worker).  Feeds the host-granular weighted split below.
+        self._worker_devices: Dict[Tuple[str, int], int] = {}
         self._members_lock = threading.Lock()
         self.cluster_ready = threading.Event()  # Master.scala:34-35
 
@@ -674,7 +678,7 @@ class MasterNode:
 
     # -- membership (Master.scala:222-253) ---------------------------------
 
-    def register_worker(self, host: str, port: int) -> None:
+    def register_worker(self, host: str, port: int, devices: int = 0) -> None:
         """Join-cap semantics: at most `expected_workers` members at any
         instant (the reference `require`s the same cap, Master.scala:224),
         but the cap is on CURRENT membership, not lifetime joins — an
@@ -686,6 +690,13 @@ class MasterNode:
         key = (host, port)
         rereg_stub = None
         with self._members_lock:
+            # host shape (docs/HIERARCHY.md): recorded for members and
+            # re-registrations alike (a restarted process may change its
+            # device count); 0/absent = flat
+            if devices > 0:
+                self._worker_devices[key] = int(devices)
+            else:
+                self._worker_devices.pop(key, None)
             if key in self._workers:
                 # already a member: either a redundant registration retry
                 # (first attempt landed but its reply was lost) or a worker
@@ -763,6 +774,7 @@ class MasterNode:
         with self._members_lock:
             self._workers.pop(key, None)
             ch = self._channels.pop(key, None)
+            self._worker_devices.pop(key, None)
             if key in self._order:
                 self._order.remove(key)
             remaining = list(self._workers.values())
@@ -779,6 +791,29 @@ class MasterNode:
     def _members(self) -> List[Tuple[Tuple[str, int], WorkerStub]]:
         with self._members_lock:
             return [(k, self._workers[k]) for k in self._order]
+
+    def _split_parts(self, split: SplitFn, members) -> List[np.ndarray]:
+        """Host-granular sample assignment (docs/HIERARCHY.md).
+
+        When every member is a flat single-device worker — or the host
+        shapes are all EQUAL, where proportional and even splits coincide
+        — this delegates to `split` untouched, so the knobs-off call
+        graph and partitions stay byte-identical to the pre-hierarchy
+        engine.  Heterogeneous host shapes weight the contiguous split by
+        each host's device count (core/split.py weighted_split) so every
+        device across the cluster owns the same expected row count.
+        Custom split strategies keep their own semantics: weighting only
+        ever replaces the default `vanilla_split`."""
+        with self._members_lock:
+            devs = [max(1, self._worker_devices.get(k, 1))
+                    for k, _ in members]
+        if (split is not vanilla_split or not devs
+                or len(set(devs)) == 1):
+            return split(len(self.train), len(members))
+        self.log.info(
+            "host-granular split: weighting partitions by device count %s",
+            devs)
+        return weighted_split(len(self.train), devs)
 
     def _stubs(self) -> List[WorkerStub]:
         return [stub for _, stub in self._members()]
@@ -821,7 +856,7 @@ class MasterNode:
             members = self._members()
             if not members:
                 raise RuntimeError("all workers lost during predict")
-            parts = split(len(self.train), len(members))
+            parts = self._split_parts(split, members)
             part_by_key = {key: ids for (key, _), ids in zip(members, parts)}
             # one trace per eval fan-out attempt (trace/): Forward calls
             # and their hedges become child spans, same as fit_sync windows
@@ -1110,7 +1145,7 @@ class MasterNode:
         self._require_ready()
         members = self._members()
         keys = [k for k, _ in members]
-        parts = split(len(self.train), len(members))
+        parts = self._split_parts(split, members)
         max_samples = max(len(p) for p in parts)
         w = (
             np.zeros(self.model.n_features, dtype=np.float32)
@@ -1132,6 +1167,19 @@ class MasterNode:
         grad_bytes = self.metrics.counter(metrics_mod.SYNC_GRAD_BYTES)
         rounds = self.metrics.counter(metrics_mod.SYNC_ROUNDS)
         window_span = batch_size * local_steps
+        # scatter-formulation attribution (ROADMAP item 2 follow-up: the
+        # DSGD_SCATTER=auto rematch outcome was only ever logged): a gauge
+        # on this fit's registry — scraped onto the cluster /metrics
+        # endpoint under telemetry — plus a flight record, and a trace
+        # event inside the first window's span below, so a bench run or a
+        # post-mortem can attribute which formulation the fit actually ran
+        from distributed_sgd_tpu.ops import mxu
+
+        scatter_form = mxu.active_scatter_formulation()
+        self.metrics.gauge(metrics_mod.SCATTER_FORMULATION).set(
+            mxu.SCATTER_FORMULATIONS.index(scatter_form))
+        flight.record("scatter.formulation", formulation=scatter_form)
+        scatter_evented = False
         # quorum bookkeeping (all inert when quorum is None):
         # ef_rollback[worker] = broadcast version whose reply the quorum
         # barrier discarded — the NEXT request to that worker carries it so
@@ -1272,7 +1320,7 @@ class MasterNode:
                     if not current:
                         raise RuntimeError("all workers lost mid-fit")
                     members, keys = current, [k for k, _ in current]
-                    parts = split(len(self.train), len(members))
+                    parts = self._split_parts(split, members)
                     max_samples = max(len(p) for p in parts)
                     bcast.forget_missing(keys)  # rejoins start from full
                     self.log.warning("membership changed; re-split across %d workers",
@@ -1290,6 +1338,10 @@ class MasterNode:
                     trace_mod.SPAN_SYNC_WINDOW, node="master", epoch=epoch,
                     batch=int(batch), version=bcast.version)
                 with wspan:
+                    if not scatter_evented:
+                        trace_mod.event(trace_mod.EVENT_SCATTER_SELECTED,
+                                        formulation=scatter_form)
+                        scatter_evented = True
                     futs = []
                     ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
                     rb_sent: Dict[Tuple[str, int], int] = {}
@@ -1835,7 +1887,7 @@ class MasterNode:
         if self._async_running.is_set():
             raise RuntimeError("a computation is already running")  # MasterAsync.scala:42
         members = self._members()
-        parts = split(len(self.train), len(members))
+        parts = self._split_parts(split, members)
         # per-worker sample assignment, kept for watchdog reassignment
         assignments = {key: part for (key, _), part in zip(members, parts)}
         w0 = (
@@ -2095,7 +2147,8 @@ class MasterNode:
         RPC-sender window is closed)."""
         if not member_order:
             raise RuntimeError("async fit: all workers lost mid-fit")
-        parts = split(len(self.train), len(member_order))
+        parts = self._split_parts(
+            split, [(k, None) for k in member_order])
         new_assign = {key: part for key, part in zip(member_order, parts)}
         changed = [key for key in member_order
                    if key not in assignments
@@ -2251,7 +2304,10 @@ class _MasterServicer:
 
     def RegisterSlave(self, request, context):  # noqa: N802
         try:
-            self.m.register_worker(request.host, request.port)
+            # Node.devices (docs/HIERARCHY.md): 0/absent from flat workers
+            # and pre-hierarchy binaries — the split stays unweighted
+            self.m.register_worker(request.host, request.port,
+                                   devices=request.devices)
         except ValueError as e:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         return pb.Ack()

@@ -58,6 +58,38 @@ def sampling_bias_bound(n_samples: int, n_workers: int) -> float:
     return max(sizes) / min(sizes)
 
 
+def weighted_split(n_samples: int, weights: List[int]) -> List[np.ndarray]:
+    """Contiguous partitions with sizes proportional to `weights` — the
+    host-granular assignment of the hierarchical topology
+    (docs/HIERARCHY.md): a host with D devices gets a D-weighted share of
+    the corpus, so every device across the cluster owns the same expected
+    row count regardless of how devices are packed into hosts.
+
+    Sizes are largest-remainder rounded (deterministic, ties broken by
+    position), so they sum to exactly `n_samples` and differ from the
+    exact proportional share by < 1 row.  With equal weights this
+    degenerates to an even contiguous split — same coverage as
+    `vanilla_split` up to the ceil-vs-even tail (the master only takes
+    this path when host shapes actually differ)."""
+    if not weights or min(weights) < 1:
+        raise ValueError(f"weights must be positive, got {weights}")
+    total = float(sum(weights))
+    exact = [n_samples * w / total for w in weights]
+    sizes = [int(e) for e in exact]
+    # largest remainder: hand the leftover rows to the biggest fractions
+    leftover = n_samples - sum(sizes)
+    order = sorted(range(len(weights)), key=lambda i: exact[i] - sizes[i],
+                   reverse=True)
+    for i in order[:leftover]:
+        sizes[i] += 1
+    idx = np.arange(n_samples, dtype=np.int64)
+    out, at = [], 0
+    for s in sizes:
+        out.append(idx[at: at + s])
+        at += s
+    return out
+
+
 def strided_split(n_samples: int, n_workers: int) -> List[np.ndarray]:
     """Round-robin split: worker i gets samples i, i+k, i+2k, ..."""
     idx = np.arange(n_samples, dtype=np.int64)

@@ -78,6 +78,9 @@ class WorkerNode:
         master_watch_s: Optional[float] = None,
         master_watch_misses: int = 3,
         telemetry: bool = False,
+        host_devices: int = 1,
+        devices=None,
+        data_offset: Optional[int] = None,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -155,12 +158,52 @@ class WorkerNode:
         self.telemetry = bool(telemetry)
         self._last_dispatch_t: Optional[float] = None
 
-        # device-resident copy of the full dataset (the reference slave also
-        # holds the full data and receives sample indices, Main.scala:138)
-        self._idx = jax.device_put(data.indices, self.device)
-        self._val = jax.device_put(data.values, self.device)
-        self._y = jax.device_put(data.labels, self.device)
+        # hierarchical in-host mesh (docs/HIERARCHY.md, DSGD_HOST_DEVICES):
+        # host_devices > 1 binds the data slice to a local D-device mesh —
+        # each Gradient / local-window dispatch shards the request batch
+        # over the local devices and reduces with ONE in-host psum, so the
+        # cross-host plane sees one reply per HOST instead of per device.
+        # host_devices=1 (default) keeps the flat single-device worker
+        # byte-identical to the pre-hierarchy engine.
+        self._hier = None
+        self.host_devices = max(1, int(host_devices))
+        if self.host_devices > 1:
+            from distributed_sgd_tpu.parallel.hier import HostMeshEngine
+
+            devs = list(devices) if devices is not None else jax.local_devices()
+            if len(devs) < self.host_devices:
+                raise ValueError(
+                    f"host_devices={self.host_devices} but only "
+                    f"{len(devs)} local device(s) are available")
+            self._hier = HostMeshEngine(model, devs[: self.host_devices], data)
+            self.device = devs[0]
+            # forward/async reuse the engine's mesh-replicated arrays
+            # (ops on replicated arrays compute fine; the sync Gradient
+            # plane is where the in-host reduction pays)
+            self._idx, self._val, self._y = (
+                self._hier.idx, self._hier.val, self._hier.y)
+        else:
+            # device-resident copy of the worker's data (the reference
+            # slave also holds the full data and receives sample indices,
+            # Main.scala:138)
+            self._idx = jax.device_put(data.indices, self.device)
+            self._val = jax.device_put(data.values, self.device)
+            self._y = jax.device_put(data.labels, self.device)
         self._n = len(data)
+        # host-local data slice (data/host_shard.py): `data` holds only
+        # global rows [data_offset, data_offset + len(data)) and incoming
+        # sample ids are mapped before any gather.  None (default) = the
+        # full corpus is resident and ids pass through untouched.
+        self._data_offset = data_offset
+        # which scatter formulation this node's kernels run, as a
+        # scrapeable gauge (ROADMAP item: the DSGD_SCATTER=auto pick was
+        # only logged; the cluster /metrics endpoint now attributes it —
+        # value indexes ops/mxu.SCATTER_FORMULATIONS)
+        from distributed_sgd_tpu.ops import mxu as _mxu
+
+        self.metrics.gauge(metrics_mod.SCATTER_FORMULATION).set(
+            _mxu.SCATTER_FORMULATIONS.index(
+                _mxu.active_scatter_formulation()))
 
         self._peers: Dict[Tuple[str, int], WorkerStub] = {}
         # bounded fire-and-forget gossip per peer (and to the master):
@@ -228,6 +271,13 @@ class WorkerNode:
 
     def _register_loop(self) -> None:
         node = pb.Node(host=self.host, port=self.port)
+        if self.host_devices > 1:
+            # host shape rides the registration (docs/HIERARCHY.md): the
+            # master weights its host-granular split by devices so a
+            # bigger host gets a proportionally bigger partition.  Flat
+            # workers leave the field unset — wire byte-identical to the
+            # pre-hierarchy Node
+            node.devices = self.host_devices
         while not self._stopped.is_set():
             attempt = 0
             while not self._stopped.is_set() and not self._registered.is_set():
@@ -391,10 +441,37 @@ class WorkerNode:
         valid[: len(ids)] = 1.0
         return jnp.asarray(padded), jnp.asarray(valid)
 
+    def _local_ids(self, ids: np.ndarray) -> np.ndarray:
+        """Map global sample ids into this worker's resident rows.
+
+        With the full corpus resident (data_offset=None, the default) ids
+        pass through untouched — zero cost on the flat path.  A host-local
+        slice (data/host_shard.py) maps id -> id - offset and REFUSES ids
+        outside the slice: silently wrapping them would compute a gradient
+        over the wrong samples, and the failed RPC surfaces at the master
+        as a classified worker failure (retry/evict), which is the honest
+        signal that the split and the resident slices disagree."""
+        if self._data_offset is None:
+            return ids
+        local = np.asarray(ids, dtype=np.int64) - self._data_offset
+        if len(local) and (local.min() < 0 or local.max() >= self._n):
+            raise ValueError(
+                f"sample ids outside this host's resident slice "
+                f"[{self._data_offset}, {self._data_offset + self._n}): "
+                f"the master's split is not host-granular for this worker")
+        return local
+
     def compute_gradient(self, w: np.ndarray, ids: np.ndarray) -> np.ndarray:
         """Sync Gradient RPC body: sum of backwards + regularize
-        (Slave.scala:142-157)."""
+        (Slave.scala:142-157).  On a hierarchical host the batch shards
+        over the local mesh and reduces with one in-host psum
+        (parallel/hier.py) — same reply, one RPC per host."""
         self._profile.tick()
+        ids = self._local_ids(ids)
+        if self._hier is not None:
+            g = self._hier.grad(np.asarray(w, dtype=np.float32), ids)
+            self.metrics.counter("slave.sync.backward").increment()
+            return g
         pids, valid = self._pad_ids(ids)
         g = self._grad_fn(len(pids))(
             jnp.asarray(w), self._idx, self._val, self._y, pids, valid
@@ -488,12 +565,19 @@ class WorkerNode:
         than k*batch_size ids — and is masked out via zeroed rows, so each
         (steps, batch_size) shape compiles exactly once."""
         self._profile.tick()
+        ids = self._local_ids(ids)
         bs = max(1, int(batch_size))
         n = len(ids)
         # step count derives from the ids actually sent, capped at k so an
         # oversized sample list cannot run more local steps than the wire
         # contract (GradientRequest.local_steps) allows
         steps = max(1, min(-(-n // bs), max(1, int(k))))
+        if self._hier is not None:
+            delta = self._hier.local_window(
+                np.asarray(w, dtype=np.float32), ids, steps, bs,
+                float(learning_rate))
+            self.metrics.counter("slave.sync.backward").increment(steps)
+            return delta
         n = min(n, steps * bs)  # excess ids beyond the k-step budget dropped
         padded = np.zeros(steps * bs, dtype=np.int32)
         padded[:n] = np.asarray(ids[:n], dtype=np.int32)
@@ -605,6 +689,7 @@ class WorkerNode:
 
         Margins ride along so the master can compute margin-based losses
         (logistic) exactly — see ForwardReply in dsgd.proto."""
+        ids = self._local_ids(ids)
         pids, _ = self._pad_ids(ids)
         wj = jnp.asarray(w)
         batch = SparseBatch(self._idx[pids], self._val[pids])
@@ -625,6 +710,21 @@ class WorkerNode:
             self.log.info("StartAsync re-issued: replacing the running async loop")
             self._running_async.clear()
             self._async_thread.join()
+        if self._hier is not None:
+            # the in-host reduction is a sync-plane lever; the async loop
+            # runs on the mesh-replicated arrays (correct, but every local
+            # device computes the same step — no speedup)
+            self.log.warning(
+                "host_devices=%d: the async loop runs replicated on the "
+                "local mesh (the in-host psum accelerates the sync "
+                "Gradient plane)", self.host_devices)
+        if self._data_offset is not None:
+            assignment = np.asarray(assignment, dtype=np.int64) - self._data_offset
+            if len(assignment) and (assignment.min() < 0
+                                    or assignment.max() >= self._n):
+                raise ValueError(
+                    "StartAsync assignment outside this host's resident "
+                    "slice (host-local loading needs a host-granular split)")
         if self._compressor is not None:
             # error-feedback residuals belong to the trajectory that
             # accumulated them: a StartAsync begins (or replaces) a session

@@ -1,0 +1,134 @@
+"""Host-local shard loading: no host materializes the global corpus.
+
+The engines' resident-dataset layout pads the corpus to
+``parallel/sync.py padded_layout(n, n_devices, eval_chunk)`` rows and
+shards it equally over the device mesh, so each host owns one contiguous
+padded row range (``parallel/multihost.py host_shard_bounds``).  This
+module turns that bound into a first-class loader: a host hands in a
+``RowReader`` — any callable ``read_rows(start, stop) -> Dataset`` over
+GLOBAL row ids — and gets back exactly its padded extent, with the real
+rows read in ONE clipped call and every padding row (index >= n_samples)
+materialized as an all-zero row with label 0 (the engines' validity
+mask).  Peak rows touched per host == the host's ``host_shard_bounds``
+extent, asserted by tests/test_host_shard.py.
+
+Consumers:
+
+- the multi-host mesh path: ``SyncEngine.bind`` routes its per-host
+  padding through ``load_host_shard`` (full-dataset reader), and
+  ``SyncEngine.bind_host_local`` / ``parallel/multihost.py
+  host_local_sharded`` build ``ShardedData`` straight from a reader so
+  the global arrays never exist on any single host
+  (tests/test_multihost_4proc.py);
+- the hierarchical RPC topology (docs/HIERARCHY.md): ``host_slice`` maps
+  a worker's position in the master's host-granular contiguous split to
+  the rows it must load, and ``WorkerNode(data_offset=...)`` maps the
+  master's global sample ids back into the slice.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from distributed_sgd_tpu.data.rcv1 import Dataset
+
+# read_rows(start, stop) -> Dataset holding global rows [start, stop);
+# start/stop are pre-clipped to [0, n_samples]
+RowReader = Callable[[int, int], Dataset]
+
+
+def dataset_reader(data: Dataset) -> RowReader:
+    """A RowReader over an in-memory dataset (tests, in-process dev
+    clusters — the memory win is a no-op there by construction)."""
+    return lambda start, stop: data.slice(slice(start, stop))
+
+
+def load_host_shard(
+    reader: RowReader,
+    n_samples: int,
+    n_features: int,
+    pad_width: int,
+    start: int,
+    end: int,
+    labels_dtype=np.int32,
+) -> Dataset:
+    """Materialize padded rows [start, end) of the engine's padded row
+    space: real rows come from ONE ``reader`` call clipped to the corpus,
+    padding rows are all-zero with label 0 (a zero row contributes zero
+    gradient in every model and the label-0 mask excludes it from eval).
+
+    The returned dataset holds exactly ``end - start`` rows — the host's
+    full resident footprint.  Nothing outside [start, min(end, n)) is
+    ever requested from the reader.
+    """
+    if not 0 <= start <= end:
+        raise ValueError(f"bad shard bounds [{start}, {end})")
+    extent = end - start
+    real_start = min(start, n_samples)
+    real_stop = min(end, n_samples)
+    # pad_width == 0 is the dense-layout discriminator (data/rcv1.py):
+    # zero-width indices, values spanning every feature
+    val_width = n_features if pad_width == 0 else pad_width
+    idx = np.zeros((extent, pad_width), dtype=np.int32)
+    val = np.zeros((extent, val_width), dtype=np.float32)
+    lab = np.zeros((extent,), dtype=labels_dtype)
+    if real_stop > real_start:
+        real = reader(real_start, real_stop)
+        n_real = real_stop - real_start
+        if len(real) != n_real:
+            raise ValueError(
+                f"reader returned {len(real)} rows for "
+                f"[{real_start}, {real_stop})")
+        if (real.indices.shape[1] != pad_width
+                or real.n_features != n_features):
+            raise ValueError(
+                f"reader shape ({real.indices.shape[1]}, "
+                f"{real.n_features}) != expected "
+                f"({pad_width}, {n_features})")
+        if not np.can_cast(real.labels.dtype, lab.dtype,
+                           casting="same_kind"):
+            # float regression targets into an int buffer would truncate
+            # silently — the caller must pass the corpus's labels_dtype
+            # (every host the same: the global array needs one dtype)
+            raise ValueError(
+                f"reader labels are {real.labels.dtype} but the shard "
+                f"buffer is {lab.dtype}: pass labels_dtype="
+                f"{real.labels.dtype}")
+        idx[:n_real] = real.indices
+        val[:n_real] = real.values
+        lab[:n_real] = real.labels
+    return Dataset(indices=idx, values=val, labels=lab,
+                   n_features=n_features)
+
+
+def host_slice(n_samples: int, host_index: int, n_hosts: int,
+               weights: Optional[List[int]] = None) -> Tuple[int, int]:
+    """[start, end) of host `host_index`'s rows under the master's
+    host-granular contiguous split (docs/HIERARCHY.md).
+
+    Mirrors core/split.py exactly: the unweighted form is vanilla_split's
+    ``grouped(ceil(n/k))`` bounds; with per-host device `weights` it is
+    weighted_split's largest-remainder layout.  A worker that loads only
+    this range (``load_host_shard`` + ``WorkerNode(data_offset=start)``)
+    serves every sample id the master can ever draw for it — as long as
+    membership matches the planned topology (a resplit after a host loss
+    redraws partitions the survivors' slices cannot cover; host-local
+    deployments pair with on_worker_death='fail' or full reloads).
+    """
+    if not 0 <= host_index < n_hosts:
+        raise ValueError(f"host_index {host_index} outside [0, {n_hosts})")
+    # derive bounds from the ACTUAL split functions the master runs —
+    # re-implementing their arithmetic here would let the worker's
+    # resident slice drift from the master's partitions the moment either
+    # changes, and every mismatched sample id is a worker eviction
+    from distributed_sgd_tpu.core.split import vanilla_split, weighted_split
+
+    parts = (vanilla_split(n_samples, n_hosts) if weights is None
+             else weighted_split(n_samples, weights))
+    part = parts[host_index]
+    if len(part) == 0:
+        at = sum(len(p) for p in parts[:host_index])
+        return at, at
+    return int(part[0]), int(part[-1]) + 1

@@ -192,6 +192,13 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             "DSGD_TELEMETRY/DSGD_HEALTH_ACTION ignored: the cluster "
             "telemetry plane is the rpc topology's (use engine=rpc; "
             "docs/OBSERVABILITY.md)")
+    if cfg.host_devices != 1:
+        # the mesh engines ARE an all-device mesh already; the in-host
+        # psum layer under an RPC plane is the rpc topology's
+        log.warning(
+            "DSGD_HOST_DEVICES ignored: the mesh engine already spans "
+            "every device — the hierarchical in-host layer is the rpc "
+            "topology's (use engine=rpc; docs/HIERARCHY.md)")
     log.info(
         "engine=mesh devices=%d virtual_workers=%d kernel=%s model=%s async=%s",
         n, virtual, cfg.kernel, cfg.model, cfg.use_async,
@@ -277,6 +284,22 @@ def _fit_state_args(cfg: Config) -> dict:
             "fit_state_every": cfg.fit_ckpt_every}
 
 
+def _resolve_host_devices(cfg: Config, dev_workers: int = 0) -> int:
+    """DSGD_HOST_DEVICES -> the worker's in-host mesh width
+    (docs/HIERARCHY.md): 0 = auto — every local device on a standalone
+    worker role, the per-worker share of the local mesh in dev mode
+    (`dev_workers` in-process workers divide what one process can see);
+    1 = the flat single-device worker, D = exactly D devices."""
+    if cfg.host_devices == 0:
+        d = jax.local_device_count()
+        if dev_workers:
+            d = max(1, d // dev_workers)
+        log.info("DSGD_HOST_DEVICES=0: auto-sized the in-host mesh to "
+                 "%d device(s)", d)
+        return d
+    return cfg.host_devices
+
+
 def _health_monitor(cfg: Config, metrics=None):
     """DSGD_HEALTH_ACTION -> telemetry.HealthMonitor (None when unset)."""
     if not cfg.health_action:
@@ -292,6 +315,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
     from distributed_sgd_tpu.core.cluster import DevCluster
 
     criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
+    host_devices = _resolve_host_devices(cfg, dev_workers=cfg.node_count)
     with DevCluster(model, train, test, n_workers=cfg.node_count, seed=cfg.seed,
                     heartbeat_s=cfg.heartbeat_s,
                     heartbeat_max_misses=cfg.heartbeat_max_misses,
@@ -300,7 +324,8 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                     compress_ef=cfg.compress_ef, chaos=cfg.chaos,
                     gossip_topology=cfg.gossip_topology,
                     telemetry_port=cfg.telemetry_port if cfg.telemetry
-                    else None) as c:
+                    else None,
+                    host_devices=host_devices) as c:
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
@@ -543,6 +568,11 @@ def _run_role(cfg: Config, role: str) -> None:
             # cluster telemetry: publish the per-dispatch health gauges
             # the master's Metrics-RPC scrape re-exports per worker
             telemetry=cfg.telemetry,
+            # hierarchical in-host mesh (docs/HIERARCHY.md): this worker
+            # becomes a D-device host — batches shard over the local
+            # devices, gradients reduce with one in-host psum, and the
+            # master's split turns host-granular via Node.devices
+            host_devices=_resolve_host_devices(cfg),
         ).start()
         worker.await_termination()
 

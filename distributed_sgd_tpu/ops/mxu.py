@@ -188,6 +188,25 @@ def resolve_scatter_formulation(
         key[0], batch_size, nnz, n_features,
         {f: f"{t * 1e6:.1f}us" for f, t in times.items()}, winner)
     _AUTO_CACHE[key] = winner
+    # surface the rematch OUTCOME beyond the log line (ROADMAP item 2
+    # follow-up): a process-global gauge (value indexes
+    # SCATTER_FORMULATIONS, scraped by /metrics exporters), a trace event
+    # (no-op unless a trace is active), and a flight record so a
+    # post-mortem dump attributes which formulation the process ran.
+    # fit_sync and WorkerNode additionally stamp their OWN registries at
+    # fit/build time — the per-fit attribution the bench gates read.
+    from distributed_sgd_tpu import trace as _trace_mod
+    from distributed_sgd_tpu.trace import flight as _flight
+    from distributed_sgd_tpu.utils import metrics as _metrics_mod
+
+    _metrics_mod.global_metrics().gauge(
+        _metrics_mod.SCATTER_FORMULATION).set(
+            SCATTER_FORMULATIONS.index(winner))
+    _trace_mod.event(_trace_mod.EVENT_SCATTER_SELECTED, formulation=winner,
+                     backend=key[0])
+    _flight.record("scatter.rematch", formulation=winner, backend=key[0],
+                   batch=int(batch_size), nnz=int(nnz),
+                   n_features=int(n_features))
     return winner
 
 

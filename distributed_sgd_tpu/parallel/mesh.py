@@ -66,6 +66,22 @@ def make_mesh(n_workers: Optional[int] = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices[:n_workers]), (WORKER_AXIS,))
 
 
+def local_device_groups(devices, n_workers: int, host_devices: int):
+    """Deterministic contiguous device groups for hierarchical in-process
+    clusters (core/cluster.py DevCluster, benches/bench_hier.py, the
+    MULTICHIP dryrun): worker i gets devices [i*D, (i+1)*D), each group
+    backing one WorkerNode's in-host mesh (parallel/hier.py).  Raises
+    when the available devices cannot host the topology."""
+    devices = list(devices)
+    need = n_workers * host_devices
+    if len(devices) < need:
+        raise ValueError(
+            f"{n_workers} workers x {host_devices} devices need {need} "
+            f"devices, found {len(devices)}")
+    return [devices[i * host_devices:(i + 1) * host_devices]
+            for i in range(n_workers)]
+
+
 def pad_to_multiple(data: Dataset, k: int) -> Dataset:
     """Pad with inert rows (all-zero features, label 0) so len % k == 0.
 

@@ -74,6 +74,41 @@ def global_mesh():
     return make_mesh(len(jax.devices()))
 
 
+def host_local_sharded(mesh, reader, n_samples: int, n_features: int,
+                       pad_width: int, eval_chunk: int = 4096,
+                       labels_dtype=None):
+    """(ShardedData, chunk) over the global mesh from ONLY this host's
+    rows: the host-local loader (data/host_shard.py) materializes just
+    [host_shard_bounds) — real rows via ONE clipped `reader` call,
+    padding rows as zeros — and `jax.make_array_from_process_local_data`
+    assembles the global batch-sharded arrays without any process ever
+    holding the corpus.  The first-class form of the hand-rolled loading
+    in tests/test_multihost_2proc.py; consumed by
+    `SyncEngine.bind_host_local` (parallel/sync.py)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_sgd_tpu.data.host_shard import load_host_shard
+    from distributed_sgd_tpu.parallel.mesh import WORKER_AXIS
+    from distributed_sgd_tpu.parallel.sync import ShardedData, padded_layout
+
+    total, chunk = padded_layout(n_samples, mesh.size, eval_chunk)
+    start, end = host_shard_bounds(n_samples, eval_chunk=eval_chunk)
+    local = load_host_shard(
+        reader, n_samples, n_features, pad_width, start, end,
+        labels_dtype=labels_dtype if labels_dtype is not None else np.int32)
+    sharding = NamedSharding(mesh, P(WORKER_AXIS))
+
+    def put(arr):
+        return jax.make_array_from_process_local_data(
+            sharding, arr, (total,) + arr.shape[1:])
+
+    sharded = ShardedData(
+        indices=put(local.indices), values=put(local.values),
+        labels=put(local.labels), n_true=n_samples)
+    return sharded, chunk
+
+
 def host_shard_bounds(
     n_samples: int,
     process_id: Optional[int] = None,

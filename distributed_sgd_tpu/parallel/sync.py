@@ -629,13 +629,17 @@ class SyncEngine:
             # corpus.  A loader that reads only its host's slice from disk
             # builds ShardedData directly instead (see
             # tests/test_multihost_2proc.py's host-local path).
+            from distributed_sgd_tpu.data.host_shard import (
+                dataset_reader,
+                load_host_shard,
+            )
             from distributed_sgd_tpu.parallel.multihost import host_shard_bounds
 
             start, end = host_shard_bounds(n_true, eval_chunk=self.eval_chunk)
-            local = _pad_to_exact(
-                data.slice(slice(min(start, n_true), min(end, n_true))),
-                end - start,
-            )
+            local = load_host_shard(
+                dataset_reader(data), n_true, data.n_features,
+                data.indices.shape[1], start, end,
+                labels_dtype=data.labels.dtype)
 
             def put(arr):
                 return jax.make_array_from_process_local_data(
@@ -662,6 +666,46 @@ class SyncEngine:
             steps_per_epoch=steps_per_epoch,
             eval_chunk=chunk,
             kernel=kernel,
+            virtual_workers=self.virtual_workers,
+            optimizer=self.optimizer,
+            momentum=self.momentum,
+            scatter=self.scatter,
+            donate=self.donate,
+        )
+
+    def bind_host_local(self, reader, n_samples: int, n_features: int,
+                        pad_width: int,
+                        steps_per_epoch: Optional[int] = None,
+                        labels_dtype=None) -> BoundSync:
+        """Multi-host bind WITHOUT the global corpus: each process hands in
+        a row reader (data/host_shard.py RowReader) and loads ONLY its
+        host_shard_bounds extent — real rows via one clipped read, padding
+        rows as zeros — so no host ever materializes the full dataset
+        (ROADMAP item 1 / VERDICT round 5; proven across 4 real processes
+        in tests/test_multihost_4proc.py).  `pad_width=0` selects the
+        dense layout (zero-width indices), mirroring Dataset.is_dense.
+
+        `labels_dtype` must match the corpus on EVERY host (one dtype
+        for the global array); None defaults to float32 for the dense
+        layout (the regression path) and int32 otherwise — the loader
+        raises on a lossy mismatch rather than truncating."""
+        from distributed_sgd_tpu.parallel.multihost import host_local_sharded
+
+        if labels_dtype is None:
+            labels_dtype = np.float32 if pad_width == 0 else np.int32
+        sharded, chunk = host_local_sharded(
+            self.mesh, reader, n_samples, n_features, pad_width,
+            eval_chunk=self.eval_chunk, labels_dtype=labels_dtype)
+        return BoundSync(
+            self.model,
+            self.mesh,
+            sharded,
+            self.batch_size,
+            self.learning_rate,
+            sampling=self.sampling,
+            steps_per_epoch=steps_per_epoch,
+            eval_chunk=chunk,
+            kernel="dense" if pad_width == 0 else self.kernel,
             virtual_workers=self.virtual_workers,
             optimizer=self.optimizer,
             momentum=self.momentum,

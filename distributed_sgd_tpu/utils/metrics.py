@@ -433,6 +433,14 @@ HEALTH_DRAIN_BACKLOG = "health.drain.backlog"       # gauge: async inbox depth (
 HEALTH_LOSS_EWMA = "health.loss.ewma"               # gauge: watchdog's smoothed loss
 HEALTH_TRIPPED = "health.tripped"                   # counter: watchdog trips
 
+# which sparse-scatter formulation the process's kernels run (DSGD_SCATTER,
+# ops/mxu.py; ROADMAP item 2 follow-up): gauge value indexes
+# mxu.SCATTER_FORMULATIONS ('onehot'=0, 'segment'=1, 'twostage'=2,
+# 'bf16'=3), set by the auto rematch, by fit_sync per fit, and by every
+# WorkerNode at build time — so bench runs and the cluster /metrics
+# endpoint attribute which formulation a fit actually ran
+SCATTER_FORMULATION = "kernel.scatter.formulation"  # gauge: formulation index
+
 
 _GLOBAL = Metrics()
 

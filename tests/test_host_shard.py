@@ -1,0 +1,167 @@
+"""Host-local shard loading (data/host_shard.py): no host materializes
+the global corpus.
+
+The contract under test: a host's loader touches EXACTLY its
+`host_shard_bounds` extent — one reader call over the clipped real-row
+range, padding rows materialized as zeros with label 0 — and the
+per-host extents tile the engine's padded row space exactly, including
+at awkward `padded_layout` shapes (short trailing shards, chunk >
+shard, n not divisible by anything)."""
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.data.host_shard import (
+    dataset_reader,
+    host_slice,
+    load_host_shard,
+)
+from distributed_sgd_tpu.data.synthetic import dense_regression, rcv1_like
+from distributed_sgd_tpu.parallel.multihost import host_shard_bounds
+from distributed_sgd_tpu.parallel.sync import _pad_to_exact, padded_layout
+
+
+class SpyReader:
+    """Counts every row the loader requests; the proof that nothing
+    outside the host's extent is ever touched."""
+
+    def __init__(self, data):
+        self.data = data
+        self.calls = []
+
+    def __call__(self, start, stop):
+        self.calls.append((start, stop))
+        return self.data.slice(slice(start, stop))
+
+    @property
+    def rows_touched(self):
+        return sum(stop - start for start, stop in self.calls)
+
+
+def test_loader_touches_exactly_the_host_extent():
+    n, n_proc, local, chunk = 103, 2, 2, 8
+    full = rcv1_like(n, n_features=64, nnz=4, seed=0)
+    total, _ = padded_layout(n, n_proc * local, chunk)
+    for pid in range(n_proc):
+        start, end = host_shard_bounds(
+            n, process_id=pid, num_processes=n_proc,
+            local_device_count=local, eval_chunk=chunk)
+        spy = SpyReader(full)
+        shard = load_host_shard(spy, n, 64, full.pad_width, start, end)
+        # exactly one reader call, clipped to the real rows of the extent
+        assert spy.calls == [(min(start, n), min(end, n))]
+        # peak rows touched == the host_shard_bounds REAL extent — the
+        # global corpus was never materialized on this "host"
+        assert spy.rows_touched == min(end, n) - min(start, n)
+        assert spy.rows_touched <= end - start < total
+        # the shard holds the full padded extent; padding rows are inert
+        assert len(shard) == end - start
+        n_real = min(end, n) - min(start, n)
+        assert np.array_equal(shard.indices[:n_real],
+                              full.indices[start:start + n_real])
+        assert not shard.values[n_real:].any()
+        assert not shard.labels[n_real:].any()  # label 0 = eval mask
+
+
+@pytest.mark.parametrize("n,n_proc,local,chunk", [
+    (103, 2, 2, 8),     # short trailing shard
+    (64, 4, 2, 8),      # even split
+    (65, 4, 2, 8),      # one extra row
+    (17, 2, 4, 16),     # chunk > shard: padded_layout clips the chunk
+    (1000, 3, 1, 7),    # nothing divides anything
+    (9, 4, 2, 4),       # more devices than chunk-sized shards
+])
+def test_bounds_tile_the_padded_layout_exactly(n, n_proc, local, chunk):
+    """Concatenating every host's loaded shard must reproduce the exact
+    padded array a single-host bind would build (`_pad_to_exact`), so
+    the global-mesh engine sees identical bytes either way."""
+    full = rcv1_like(n, n_features=32, nnz=3, seed=1)
+    total, _ = padded_layout(n, n_proc * local, chunk)
+    bounds = [host_shard_bounds(n, process_id=p, num_processes=n_proc,
+                                local_device_count=local, eval_chunk=chunk)
+              for p in range(n_proc)]
+    # contiguous disjoint tiling of [0, total)
+    assert bounds[0][0] == 0 and bounds[-1][1] == total
+    for (s0, e0), (s1, e1) in zip(bounds, bounds[1:]):
+        assert e0 == s1 and s0 < e0
+    shards = [load_host_shard(dataset_reader(full), n, 32, full.pad_width,
+                              s, e) for s, e in bounds]
+    whole = _pad_to_exact(full, total)
+    assert np.array_equal(np.concatenate([s.indices for s in shards]),
+                          whole.indices)
+    assert np.array_equal(np.concatenate([s.values for s in shards]),
+                          whole.values)
+    assert np.array_equal(np.concatenate([s.labels for s in shards]),
+                          whole.labels)
+
+
+def test_loader_dense_layout():
+    full = dense_regression(20, n_features=16, seed=0)
+    shard = load_host_shard(dataset_reader(full), 20, 16, 0, 12, 24,
+                            labels_dtype=np.float32)
+    assert shard.is_dense
+    assert len(shard) == 12
+    assert np.array_equal(shard.values[:8], full.values[12:20])
+    assert not shard.values[8:].any()
+    # float regression targets survive exactly — and an int buffer would
+    # have truncated them, so the loader refuses the lossy cast loudly
+    assert np.array_equal(shard.labels[:8], full.labels[12:20])
+    with pytest.raises(ValueError, match="labels are float32"):
+        load_host_shard(dataset_reader(full), 20, 16, 0, 12, 24)
+
+
+def test_bind_host_local_preserves_regression_labels():
+    """bind_host_local must carry the corpus's labels dtype into the
+    global array — a dense regression corpus defaults to float32 targets
+    (silent int truncation was the failure mode)."""
+    import jax.numpy as jnp
+
+    from distributed_sgd_tpu.models.linear import make_model
+    from distributed_sgd_tpu.parallel.mesh import make_mesh
+    from distributed_sgd_tpu.parallel.sync import SyncEngine
+
+    full = dense_regression(64, n_features=16, seed=0)
+    model = make_model("least_squares", 1e-4, 16)
+    engine = SyncEngine(model, make_mesh(4), batch_size=4,
+                        learning_rate=0.01, eval_chunk=4)
+    bound = engine.bind_host_local(dataset_reader(full), 64, 16, 0)
+    lab = np.asarray(bound.data.labels)[:64]
+    assert lab.dtype == np.float32
+    np.testing.assert_array_equal(lab, full.labels)
+    loss, _ = bound.evaluate(jnp.zeros(16, jnp.float32))
+    assert np.isfinite(loss)
+
+
+def test_loader_refuses_bad_reader_shapes():
+    full = rcv1_like(20, n_features=32, nnz=3, seed=0)
+    with pytest.raises(ValueError, match="reader returned"):
+        load_host_shard(lambda s, e: full.slice(slice(s, e - 1)),
+                        20, 32, full.pad_width, 0, 10)
+    with pytest.raises(ValueError, match="reader shape"):
+        load_host_shard(dataset_reader(full), 20, 32, full.pad_width + 1,
+                        0, 10)
+    with pytest.raises(ValueError, match="bad shard bounds"):
+        load_host_shard(dataset_reader(full), 20, 32, full.pad_width, 5, 3)
+
+
+def test_host_slice_matches_the_master_split():
+    """The worker-side bounds (host_slice) must agree with the master's
+    contiguous splits (core/split.py) — unweighted with vanilla_split,
+    weighted with weighted_split — or host-local workers would refuse
+    the master's sample ids."""
+    from distributed_sgd_tpu.core.split import vanilla_split, weighted_split
+
+    for n, hosts in [(103, 4), (100, 3), (7, 4), (64, 8)]:
+        parts = vanilla_split(n, hosts)
+        for i, part in enumerate(parts):
+            start, end = host_slice(n, i, hosts)
+            assert end - start == len(part)
+            if len(part):
+                assert (start, end) == (int(part[0]), int(part[-1]) + 1)
+    for n, weights in [(103, [2, 1, 1]), (100, [4, 2, 2]), (11, [3, 1])]:
+        parts = weighted_split(n, weights)
+        for i, part in enumerate(parts):
+            start, end = host_slice(n, i, len(weights), weights=weights)
+            assert end - start == len(part)
+            if len(part):
+                assert (start, end) == (int(part[0]), int(part[-1]) + 1)
