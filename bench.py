@@ -366,6 +366,17 @@ def main() -> None:
 
         bench_hier.main(smoke="--smoke" in sys.argv)
         return
+    if "--serve" in sys.argv:
+        # serving-fleet SLO gate (docs/SERVING.md "serving fleet"): the
+        # closed loop — DevCluster trains while a 3-replica fleet serves,
+        # checkpoints stream in as weight deltas through the router's
+        # canary gate — hard-asserting zero dropped requests and the p99
+        # SLO under one replica kill + one canary rollback, plus the
+        # delta-vs-full-reload wire savings.  --smoke is the CI-sized mode.
+        from benches import bench_serve
+
+        bench_serve.main(smoke="--smoke" in sys.argv)
+        return
     if "--chaos" in sys.argv:
         # chaos gate (docs/FAULT_TOLERANCE.md): sync training under the
         # canonical seeded fault plan, quorum on vs off — asserts

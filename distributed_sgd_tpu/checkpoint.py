@@ -79,6 +79,21 @@ class Checkpointer:
         """
         self._mgr.reload()
 
+    def poll_newer(self, than: Optional[int]) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """One reader-side poll step: drop the cached directory listing,
+        and restore the latest snapshot iff its step is newer than `than`
+        (None = anything counts as newer).  Returns None when nothing
+        newer exists — or when the newest snapshot was deleted between
+        listing and restore (restore_latest re-lists).  The shared dance
+        of every directory WATCHER: the serving hot-reload poll
+        (serving/model_store.py) and the fleet checkpoint distributor
+        (serving/push.py CheckpointDistributor)."""
+        self.reload()
+        step = self.latest_step()
+        if step is None or (than is not None and step <= than):
+            return None
+        return self.restore_latest()
+
     def restore_latest(self) -> Optional[Tuple[int, Dict[str, Any]]]:
         from distributed_sgd_tpu.utils.measure import span
 

@@ -190,17 +190,44 @@ class Config:
     # (jax.local_device_count(), resolved at role start-up).
     host_devices: int = 1
 
-    # -- serving role (serving/; docs/SERVING.md) --------------------------
+    # -- serving roles (serving/; docs/SERVING.md) -------------------------
     # DSGD_ROLE overrides the master_host/master_port-derived role below;
-    # 'serve' is the only role with no derivation rule (a serving replica
-    # has no place in the training topology), the other three make an
-    # implicit deployment explicit.  None = derive (reference behavior).
+    # 'serve' (a replica / single node) and 'route' (the fleet router) are
+    # the roles with no derivation rule (neither has a place in the
+    # training topology), the other three make an implicit deployment
+    # explicit.  None = derive (reference behavior).
     role_override: Optional[str] = None
-    serve_port: int = 4100  # gRPC dsgd.Serving bind port
+    serve_port: int = 4100  # gRPC dsgd.Serving bind port (replica OR router)
     serve_max_batch: int = 64  # micro-batch flush size cap
     serve_max_delay_ms: float = 5.0  # coalescing window from oldest queued row
     serve_queue_depth: int = 256  # admission bound -> RESOURCE_EXHAUSTED
     serve_ckpt_poll_s: float = 2.0  # checkpoint hot-reload poll period
+    # -- serving fleet (serving/router.py + serving/push.py) ---------------
+    # All default-off: with every knob below unset, role=serve builds the
+    # single-node server byte-identical to the pre-fleet subsystem
+    # (asserted by tests/test_router.py).
+    # role=serve only: N in-process replicas behind an in-process router
+    # on serve_port (the one-machine fleet; kube runs real pods instead).
+    # 0 = the single-node server.
+    serve_replicas: int = 0
+    # role=route: the replica endpoints to balance over, 'host:port,...'
+    # (kube/serve.yaml lists the StatefulSet pod DNS names here)
+    serve_targets: Optional[str] = None
+    # master/dev roles: fleet endpoints (typically the ROUTER) the
+    # trainer's checkpoint distributor streams weight deltas to
+    # (serving/push.py CheckpointDistributor); needs DSGD_CHECKPOINT_DIR
+    serve_push: Optional[str] = None
+    # canary fraction of the fleet a pushed version lands on first; the
+    # router promotes it fleet-wide only when the probe-set loss does not
+    # regress vs the promoted baseline (0 = no canary gate)
+    serve_canary: float = 0.0
+    # held-out probe set for the canary gate: an .npz with padded 2-D
+    # indices/values + 1-D labels (serving/router.py load_probe)
+    serve_probe: Optional[str] = None
+    # hedge deadline: a routed Predict slower than this races a duplicate
+    # on the next-best replica, first success wins (0 = no hedging)
+    serve_hedge_ms: float = 0.0
+    serve_health_s: float = 1.0  # router ServeHealth poll period
 
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
@@ -302,10 +329,11 @@ class Config:
                 "exclusive: virtual_workers pins the per-device emulation "
                 "directly, so the exact-topology solver would be ignored"
             )
-        if self.role_override not in (None, "dev", "master", "worker", "serve"):
+        if self.role_override not in (None, "dev", "master", "worker",
+                                      "serve", "route"):
             raise ValueError(
                 f"DSGD_ROLE={self.role_override!r} must be one of "
-                f"dev | master | worker | serve (unset = derive from "
+                f"dev | master | worker | serve | route (unset = derive from "
                 f"master_host/master_port)"
             )
         if self.role_override == "serve" and not self.checkpoint_dir:
@@ -321,11 +349,47 @@ class Config:
             raise ValueError("serve_queue_depth must be >= 1")
         if self.serve_ckpt_poll_s <= 0:
             raise ValueError("serve_ckpt_poll_s must be > 0")
+        # -- serving fleet (docs/SERVING.md "serving fleet") ----------------
+        if self.serve_replicas < 0:
+            raise ValueError("serve_replicas must be >= 0 (0 = single node)")
+        if self.role_override == "route" and not self.serve_targets:
+            raise ValueError(
+                "role=route needs DSGD_SERVE_TARGETS: the router balances "
+                "over an explicit replica endpoint list (host:port,...)")
+        for spec in (self.serve_targets, self.serve_push):
+            if spec:
+                # fail endpoint-list typos at construction, not mid-route;
+                # grammar owned by serving.push.parse_targets
+                from distributed_sgd_tpu.serving.push import parse_targets
+
+                parse_targets(spec)
+        if self.serve_push and not self.checkpoint_dir:
+            raise ValueError(
+                "DSGD_SERVE_PUSH needs DSGD_CHECKPOINT_DIR: the checkpoint "
+                "distributor watches the trainer's checkpoint directory")
+        if not 0.0 <= self.serve_canary <= 1.0:
+            raise ValueError("serve_canary must be a fraction in [0, 1]")
+        if (self.serve_canary > 0 and not self.serve_probe
+                and self.role_override in ("route", "serve")):
+            # an armed canary with nothing to evaluate would silently
+            # promote every version ungated — the operator believes a
+            # gate exists; fail at construction like every other
+            # cross-field dependency (fleet APIs pass probe rows
+            # directly, so only the env-driven roles need the pairing)
+            raise ValueError(
+                "DSGD_SERVE_CANARY > 0 needs DSGD_SERVE_PROBE: the canary "
+                "gate evaluates pushed versions against a held-out probe "
+                "set (docs/SERVING.md)")
+        if self.serve_hedge_ms < 0:
+            raise ValueError("serve_hedge_ms must be >= 0 (0 = no hedging)")
+        if self.serve_health_s <= 0:
+            raise ValueError("serve_health_s must be > 0")
 
     @property
     def role(self) -> str:
         """'dev' | 'master' | 'worker' per Main.scala:122-159, or any of
-        those plus 'serve' when DSGD_ROLE overrides the derivation."""
+        those plus 'serve' / 'route' when DSGD_ROLE overrides the
+        derivation."""
         if self.role_override is not None:
             return self.role_override
         if self.master_host is None or self.master_port is None:
@@ -405,6 +469,13 @@ class Config:
             serve_max_delay_ms=_env("DSGD_SERVE_MAX_DELAY_MS", cls.serve_max_delay_ms, float),
             serve_queue_depth=_env("DSGD_SERVE_QUEUE_DEPTH", cls.serve_queue_depth, int),
             serve_ckpt_poll_s=_env("DSGD_SERVE_CKPT_POLL_S", cls.serve_ckpt_poll_s, float),
+            serve_replicas=_env("DSGD_SERVE_REPLICAS", cls.serve_replicas, int),
+            serve_targets=_env("DSGD_SERVE_TARGETS", None, str),
+            serve_push=_env("DSGD_SERVE_PUSH", None, str),
+            serve_canary=_env("DSGD_SERVE_CANARY", cls.serve_canary, float),
+            serve_probe=_env("DSGD_SERVE_PROBE", None, str),
+            serve_hedge_ms=_env("DSGD_SERVE_HEDGE_MS", cls.serve_hedge_ms, float),
+            serve_health_s=_env("DSGD_SERVE_HEALTH_S", cls.serve_health_s, float),
         )
         return dataclasses.replace(cfg, **overrides)
 

@@ -448,17 +448,14 @@ class _BroadcastState:
 
     def _compute_delta(self, w: np.ndarray):
         """The sparse WeightDelta vs the previous version, or False when a
-        full tensor is the smaller (or only possible) wire form."""
-        if self._w_prev is None:
-            return False
-        changed = np.nonzero(w != self._w_prev)[0]
-        if len(changed) > self.SPARSE_BREAK_EVEN * len(w):
-            return False  # dense-ish: full is smaller
-        return pb.WeightDelta(
-            base_version=self.version - 1,
-            indices=changed.astype(np.int32),
-            values=np.ascontiguousarray(w[changed]),
-        )
+        full tensor is the smaller (or only possible) wire form.  The
+        encode itself is the shared absolute-value delta codec
+        (rpc/codec.py encode_weight_delta) — the same path the serving
+        fleet's checkpoint distribution rides (serving/push.py)."""
+        delta = codec.encode_weight_delta(
+            w, self._w_prev, base_version=self.version - 1,
+            break_even=self.SPARSE_BREAK_EVEN)
+        return False if delta is None else delta
 
     def _delta(self, w: np.ndarray):
         self._join_encode()

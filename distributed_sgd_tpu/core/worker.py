@@ -516,11 +516,7 @@ class WorkerNode:
             if cached_ver == version:
                 return cached, False  # retry / already-applied: idempotent
             if request.HasField("delta") and cached_ver == request.delta.base_version:
-                d = request.delta
-                w = cached.copy()
-                if len(d.indices):
-                    w[np.asarray(d.indices, dtype=np.int64)] = np.asarray(
-                        d.values, dtype=np.float32)
+                w = codec.apply_weight_delta(cached, request.delta)
                 self._replica = (tok, version, w)
                 return w, False
             return None, True

@@ -478,7 +478,84 @@ def _select_scatter(cfg: Config, data: Dataset) -> None:
              " (DSGD_SCATTER=auto rematch)" if cfg.scatter == "auto" else "")
 
 
+def _load_probe(cfg: Config):
+    """DSGD_SERVE_PROBE -> canary probe rows (None when unset)."""
+    if not cfg.serve_probe:
+        return None
+    from distributed_sgd_tpu.serving.router import load_probe
+
+    probe = load_probe(cfg.serve_probe)
+    log.info("canary probe set: %d rows from %s", len(probe), cfg.serve_probe)
+    return probe
+
+
+def _serve_distributor(cfg: Config):
+    """DSGD_SERVE_PUSH on a training role -> started CheckpointDistributor
+    (None when unset): every checkpoint the fit writes streams to the
+    fleet as a versioned weight delta (docs/SERVING.md "serving fleet");
+    config validation already required checkpoint_dir."""
+    if not cfg.serve_push:
+        return None
+    from distributed_sgd_tpu.serving.push import CheckpointDistributor, parse_targets
+
+    targets = parse_targets(cfg.serve_push)
+    log.info("checkpoint distributor on: %s -> %s",
+             cfg.checkpoint_dir, cfg.serve_push)
+    return CheckpointDistributor(
+        cfg.checkpoint_dir, targets,
+        metrics=metrics_mod.global_metrics()).start()
+
+
 def _run_role(cfg: Config, role: str) -> None:
+    if role == "route":
+        # Serving-fleet router (serving/router.py; DSGD_ROLE=route): fans
+        # Predict traffic over DSGD_SERVE_TARGETS with health-aware
+        # power-of-two-choices balancing, and gates pushed checkpoint
+        # versions through the canary fraction (docs/SERVING.md).
+        from distributed_sgd_tpu.serving.push import parse_targets
+        from distributed_sgd_tpu.serving.router import ServingRouter
+
+        router = ServingRouter(
+            parse_targets(cfg.serve_targets), port=cfg.serve_port,
+            model=cfg.model, lam=cfg.lam,
+            canary_fraction=cfg.serve_canary, probe=_load_probe(cfg),
+            hedge_ms=cfg.serve_hedge_ms, health_s=cfg.serve_health_s,
+            telemetry_port=cfg.telemetry_port if cfg.telemetry else None,
+            metrics=metrics_mod.global_metrics(), seed=cfg.seed,
+        ).start()
+        log.info("routing on :%d over %s (canary=%g, hedge=%gms)",
+                 router.bound_port, cfg.serve_targets, cfg.serve_canary,
+                 cfg.serve_hedge_ms)
+        try:
+            router.await_termination()
+        finally:
+            router.stop()
+        return
+    if role == "serve" and cfg.serve_replicas > 0:
+        # One-machine fleet (serving/fleet.py): DSGD_SERVE_REPLICAS
+        # in-process replicas behind an in-process router on serve_port —
+        # the kube deployment runs the same two roles as real pods.
+        from distributed_sgd_tpu.serving.fleet import ServingFleet
+
+        fleet = ServingFleet(
+            cfg.checkpoint_dir, cfg.serve_replicas, model=cfg.model,
+            lam=cfg.lam, router_port=cfg.serve_port,
+            max_batch=cfg.serve_max_batch,
+            max_delay_ms=cfg.serve_max_delay_ms,
+            queue_depth=cfg.serve_queue_depth,
+            ckpt_poll_s=cfg.serve_ckpt_poll_s,
+            canary_fraction=cfg.serve_canary, probe=_load_probe(cfg),
+            hedge_ms=cfg.serve_hedge_ms, health_s=cfg.serve_health_s,
+            telemetry_port=cfg.telemetry_port if cfg.telemetry else None,
+            metrics=metrics_mod.global_metrics(), seed=cfg.seed,
+        ).start()
+        log.info("serving fleet: router :%d over %d in-process replicas",
+                 fleet.router_port, cfg.serve_replicas)
+        try:
+            fleet.await_termination()
+        finally:
+            fleet.stop()
+        return
     if role == "serve":
         # Online inference front end (serving/; DSGD_ROLE=serve): no
         # training data, no cluster membership — it loads weights from
@@ -502,10 +579,15 @@ def _run_role(cfg: Config, role: str) -> None:
     if role == "dev":
         train, test, model = build(cfg)
         _select_scatter(cfg, train)
-        if cfg.engine == "rpc":
-            scenario_rpc(cfg, train, test, model)
-        else:
-            scenario_mesh(cfg, train, test, model)
+        distributor = _serve_distributor(cfg)
+        try:
+            if cfg.engine == "rpc":
+                scenario_rpc(cfg, train, test, model)
+            else:
+                scenario_mesh(cfg, train, test, model)
+        finally:
+            if distributor is not None:
+                distributor.stop()
     elif role == "master":
         from distributed_sgd_tpu.core.master import MasterNode
 
@@ -524,27 +606,36 @@ def _run_role(cfg: Config, role: str) -> None:
         criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
         master.await_ready()
         ckpt = _make_checkpointer(cfg)
-        if cfg.use_async:
-            res = master.fit_async(
-                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
-                check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
-                initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
-                optimizer=cfg.optimizer, momentum=cfg.momentum,
-                elastic=cfg.elastic, batch_drain=cfg.async_drain,
-            )
-        else:
-            res = master.fit_sync(
-                cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
-                checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
-                optimizer=cfg.optimizer, momentum=cfg.momentum,
-                local_steps=cfg.local_steps,
-                delta_broadcast=cfg.delta_broadcast,
-                quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
-                health=_health_monitor(cfg, metrics=master.metrics),
-                **_fit_state_args(cfg),
-            )
-        _finish(cfg, res, evaluator=lambda w: master.local_loss(w, test=True),
-                saved=ckpt is not None)
+        distributor = _serve_distributor(cfg)
+        try:
+            if cfg.use_async:
+                res = master.fit_async(
+                    cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
+                    check_every=cfg.check_every, leaky_loss=cfg.leaky_loss,
+                    initial_weights=_restore_weights(ckpt), checkpointer=ckpt,
+                    optimizer=cfg.optimizer, momentum=cfg.momentum,
+                    elastic=cfg.elastic, batch_drain=cfg.async_drain,
+                )
+            else:
+                res = master.fit_sync(
+                    cfg.max_epochs, cfg.batch_size, cfg.learning_rate, criterion,
+                    checkpointer=ckpt, checkpoint_every=cfg.checkpoint_every,
+                    optimizer=cfg.optimizer, momentum=cfg.momentum,
+                    local_steps=cfg.local_steps,
+                    delta_broadcast=cfg.delta_broadcast,
+                    quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
+                    health=_health_monitor(cfg, metrics=master.metrics),
+                    **_fit_state_args(cfg),
+                )
+            _finish(cfg, res,
+                    evaluator=lambda w: master.local_loss(w, test=True),
+                    saved=ckpt is not None)
+        finally:
+            if distributor is not None:
+                # stop() runs one final sweep, so the terminal checkpoint
+                # the fit wrote still reaches the fleet — on EVERY exit
+                # path, like the dev branch
+                distributor.stop()
         master.stop()
     else:  # worker
         from distributed_sgd_tpu.core.worker import WorkerNode

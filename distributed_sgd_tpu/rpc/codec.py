@@ -16,6 +16,8 @@ never need to know what the sender negotiated.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
@@ -127,6 +129,52 @@ def _qint8_values(c: pb.CompressedGrad) -> np.ndarray:
     chunk = max(1, c.chunk or QINT8_CHUNK)
     scales = np.asarray(c.scales, dtype=np.float32)
     return codes * np.repeat(scales, chunk)[: c.size]
+
+
+# -- versioned weight deltas (docs/SYNC_PIPELINE.md, docs/SERVING.md) ---------
+#
+# The ONE encode/apply pair for sparse absolute-value weight updates, shared
+# by the sync broadcast plane (core/master.py _BroadcastState -> worker
+# replica caches) and the serving fleet's checkpoint distribution
+# (serving/push.py WeightPusher -> ModelStore.apply_push, and the router's
+# own promoted-weights cache).  `values` are ABSOLUTE new weights at
+# `indices` (assignment, not increment): application is idempotent and
+# reconstructs the sender's vector bit-exactly.
+
+SPARSE_BREAK_EVEN = 0.5  # changed fraction above which dense is smaller
+
+
+def encode_weight_delta(
+    w: np.ndarray, w_prev: Optional[np.ndarray], base_version: int,
+    break_even: float = SPARSE_BREAK_EVEN,
+) -> Optional[pb.WeightDelta]:
+    """Sparse WeightDelta of `w` vs `w_prev`, or None when a full tensor is
+    the smaller (or only possible) wire form: no previous vector, or more
+    than `break_even` of the coordinates changed (8 bytes/changed
+    coordinate vs 4 bytes/element dense -> break-even at 50% density)."""
+    if w_prev is None or w_prev.shape != w.shape:
+        return None
+    changed = np.nonzero(w != w_prev)[0]
+    if len(changed) > break_even * len(w):
+        return None  # dense-ish: full is smaller
+    return pb.WeightDelta(
+        base_version=int(base_version),
+        indices=changed.astype(np.int32),
+        values=np.ascontiguousarray(w[changed]),
+    )
+
+
+def apply_weight_delta(w: np.ndarray, delta: pb.WeightDelta) -> np.ndarray:
+    """New weight vector: `w` with the delta's ABSOLUTE values assigned at
+    its indices.  Returns a fresh array; the caller's `w` is untouched (a
+    published snapshot must never mutate under a reader).  Version
+    bookkeeping (does `delta.base_version` match what `w` is?) belongs to
+    the caller — this is pure application."""
+    out = np.asarray(w, dtype=np.float32).copy()
+    if len(delta.indices):
+        out[np.asarray(delta.indices, dtype=np.int64)] = np.asarray(
+            delta.values, dtype=np.float32)
+    return out
 
 
 def decode_grad_into(g: pb.GradUpdate, out: np.ndarray, scale: float = 1.0) -> np.ndarray:

@@ -263,17 +263,24 @@ _WORKER_METHODS = {
 }
 
 # The inference front end (serving/): no reference counterpart — the
-# reference's only inference surface is the in-fit Forward above.
+# reference's only inference surface is the in-fit Forward above.  The
+# router (serving/router.py) speaks the SAME service, so a client cannot
+# tell one replica from a fleet.
 _SERVE_METHODS = {
     "Predict": (pb.PredictRequest, pb.PredictReply),
     "ServeHealth": (pb.Empty, pb.ServeHealthReply),
     "Metrics": (pb.Empty, pb.MetricsSnapshot),
+    # delta checkpoint distribution (docs/SERVING.md "serving fleet"): the
+    # trainer's master — or the router fanning a push out — streams
+    # versioned weight updates; an older replica answers UNIMPLEMENTED and
+    # keeps hot-reloading from the checkpoint files instead
+    "PushWeights": (pb.PushWeightsRequest, pb.PushWeightsReply),
 }
 
 # Methods a servicer may legitimately lack (older binaries, partial test
 # stubs): absent -> no handler -> UNIMPLEMENTED to callers.  Everything
 # else is required and fails server construction when missing.
-_OPTIONAL_METHODS = frozenset({"Metrics"})
+_OPTIONAL_METHODS = frozenset({"Metrics", "PushWeights"})
 
 
 def _traced_handler(fn, method: str, node: Optional[str]):

@@ -13,23 +13,38 @@ jitted sparse forward pass the trainers use:
 - `bucketing`: powers-of-two (batch, nnz) shape buckets so the jit cache
   stays small and warm;
 - `model_store.ModelStore`: loads `checkpoint.py`-format snapshots and
-  hot-swaps them atomically when the trainer saves a new one — no restart;
+  hot-swaps them atomically when the trainer saves a new one — no restart
+  — or applies pushed weight deltas in place (`PushWeights`);
 - `server.ServingServer`: the gRPC `dsgd.Serving` front end
-  (Predict/ServeHealth, rpc/service.py method table), wired into main.py
-  as the `DSGD_ROLE=serve` role;
-- `health_probe`: exec-style readiness probe for kube/serve.yaml.
+  (Predict/ServeHealth/PushWeights, rpc/service.py method table), wired
+  into main.py as the `DSGD_ROLE=serve` role;
+- `health_probe`: exec-style readiness probe for kube/serve.yaml;
+- `router.ServingRouter`: the fleet front (`DSGD_ROLE=route`) — N
+  shared-nothing replicas behind power-of-two-choices health-aware load
+  balancing, hedged failover, and a canary gate on pushed versions;
+- `push.WeightPusher` / `push.CheckpointDistributor`: the trainer side of
+  delta checkpoint distribution (versioned sparse weight deltas instead
+  of N full-file reloads);
+- `fleet.ServingFleet`: in-process N-replica fleet + router harness.
 
 Design + backpressure contract: docs/SERVING.md.
 """
 
 from distributed_sgd_tpu.serving.batcher import MicroBatcher, QueueFull
+from distributed_sgd_tpu.serving.fleet import ServingFleet
 from distributed_sgd_tpu.serving.model_store import ModelStore
+from distributed_sgd_tpu.serving.push import CheckpointDistributor, WeightPusher
+from distributed_sgd_tpu.serving.router import ServingRouter
 from distributed_sgd_tpu.serving.server import PredictEngine, ServingServer
 
 __all__ = [
+    "CheckpointDistributor",
     "MicroBatcher",
     "ModelStore",
     "PredictEngine",
     "QueueFull",
+    "ServingFleet",
+    "ServingRouter",
     "ServingServer",
+    "WeightPusher",
 ]

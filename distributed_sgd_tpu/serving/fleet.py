@@ -1,0 +1,104 @@
+"""In-process serving fleet over real loopback gRPC — the serving sibling
+of core/cluster.DevCluster.
+
+N ``ServingServer`` replicas (each with its OWN metrics registry, so the
+router's telemetry endpoint folds N distinct ``serve:<port>`` labels
+instead of one shared registry counted N times — the DevCluster telemetry
+discipline) behind one ``ServingRouter``, all on OS-assigned loopback
+ports.  Used by tests/test_router.py, benches/bench_serve.py, and the
+``DSGD_ROLE=serve`` + ``DSGD_SERVE_REPLICAS=N`` single-machine fleet mode
+in main.py; the kube deployment runs the same two roles as real pods
+(kube/serve.yaml).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from distributed_sgd_tpu.serving.router import ServingRouter
+from distributed_sgd_tpu.serving.server import ServingServer
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+log = logging.getLogger("dsgd.serving")
+
+
+class ServingFleet:
+    def __init__(
+        self,
+        checkpoint_dir: str,
+        n_replicas: int,
+        model: str = "hinge",
+        lam: float = 1e-5,
+        host: str = "127.0.0.1",
+        router_port: int = 0,
+        max_batch: int = 64,
+        max_delay_ms: float = 5.0,
+        queue_depth: int = 256,
+        ckpt_poll_s: float = 2.0,
+        canary_fraction: float = 0.0,
+        canary_ratio: float = 1.05,
+        probe=None,
+        hedge_ms: float = 0.0,
+        health_s: float = 1.0,
+        request_timeout_s: float = 30.0,
+        telemetry_port: Optional[int] = None,
+        metrics=None,
+        seed: int = 0,
+    ):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self.replicas: List[ServingServer] = [
+            ServingServer(
+                checkpoint_dir, model=model, lam=lam, port=0, host=host,
+                max_batch=max_batch, max_delay_ms=max_delay_ms,
+                queue_depth=queue_depth, ckpt_poll_s=ckpt_poll_s,
+                metrics=metrics_mod.Metrics(),
+                request_timeout_s=request_timeout_s,
+            )
+            for _ in range(n_replicas)
+        ]
+        self.router = ServingRouter(
+            [(host, r.bound_port) for r in self.replicas],
+            port=router_port, host=host, model=model, lam=lam,
+            canary_fraction=canary_fraction, canary_ratio=canary_ratio,
+            probe=probe, hedge_ms=hedge_ms, health_s=health_s,
+            request_timeout_s=request_timeout_s,
+            telemetry_port=telemetry_port, metrics=metrics, seed=seed,
+        )
+
+    @property
+    def router_port(self) -> int:
+        return self.router.bound_port
+
+    def kill_replica(self, i: int) -> None:
+        """Hard-stop replica `i` mid-traffic (failover/chaos tests): its
+        server goes away like a crashed pod; the router's health loop and
+        breakers drain it with zero dropped requests."""
+        log.warning("killing replica %d (:%d)", i, self.replicas[i].bound_port)
+        self.replicas[i].stop()
+
+    def start(self) -> "ServingFleet":
+        for r in self.replicas:
+            r.start()
+        self.router.start()
+        log.info("serving fleet up: router :%d over %d replicas",
+                 self.router_port, len(self.replicas))
+        return self
+
+    def await_termination(self) -> None:
+        self.router.await_termination()
+
+    def stop(self) -> None:
+        self.router.stop()
+        for r in self.replicas:
+            try:
+                r.stop()
+            except Exception:  # noqa: BLE001 - a killed replica stops twice
+                pass
+
+    def __enter__(self) -> "ServingFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

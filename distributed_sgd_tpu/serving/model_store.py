@@ -1,9 +1,24 @@
-"""Checkpoint-backed model store with atomic hot-swap.
+"""Checkpoint-backed model store with atomic hot-swap and push-apply.
 
-Serves the model the trainer just saved, with no server restart: a
-background poll re-reads the checkpoint directory (`Checkpointer.reload`)
-every `poll_s` seconds and, when a newer step appears, restores its
-weights and swaps the published snapshot in one reference assignment.
+Serves the model the trainer just saved, with no server restart.  Two
+update paths feed the published snapshot:
+
+- **file poll** (the PR-1 path, always on by default): a background poll
+  re-reads the checkpoint directory (`Checkpointer.poll_newer`) every
+  `poll_s` seconds and, when a newer step appears, restores its weights
+  and swaps the published snapshot in one reference assignment;
+- **push** (`apply_push`, the serving-fleet path — docs/SERVING.md): the
+  trainer's master streams versioned weight updates over the `PushWeights`
+  RPC — a full tensor, or a sparse absolute-value `WeightDelta` applied IN
+  PLACE on top of the current snapshot (rpc/codec.py `apply_weight_delta`,
+  the same codec the sync broadcast plane uses).  The first applied push
+  switches the store to push mode: the periodic file poll stops swapping
+  (the push stream is authoritative — after a canary rollback the file
+  may hold exactly the version that was rolled back), but a push whose
+  delta base does not match the current snapshot (version gap: restarted
+  replica, missed push) NACKs and falls back to one forced full-file
+  reload, so a replica can always catch up from the shared directory.
+
 Readers (`get()`) always see a complete (step, weights) pair — a flush
 that started on step N finishes on step N even if N+1 lands mid-batch,
 and the NEXT flush picks up N+1.
@@ -15,7 +30,10 @@ history are ignored.
 
 A restore that fails (e.g. the poll raced a half-committed write before
 orbax finalized it) keeps the previous snapshot and counts
-`serve.model.reload.errors`; successful swaps count `serve.model.reload`.
+`serve.model.reload.errors`; successful swaps count `serve.model.reload`,
+applied pushes count `serve.model.push.full` / `serve.model.push.delta`,
+and every swap (either path) publishes the `serve.model.version` gauge so
+the cluster /metrics endpoint shows which version each replica serves.
 """
 
 from __future__ import annotations
@@ -25,6 +43,9 @@ import threading
 from typing import Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
+
+from distributed_sgd_tpu.utils import metrics as metrics_mod
 
 log = logging.getLogger("dsgd.serving")
 
@@ -39,8 +60,14 @@ class ModelStore:
         self.poll_s = float(poll_s)
         self._metrics = metrics
         # the published snapshot; swapped by ONE reference assignment, so
-        # readers never lock
+        # readers never lock.  _swap_lock serializes WRITERS only (the poll
+        # thread vs concurrent PushWeights servicer calls — a delta apply
+        # is a read-modify-write and must not race another swap).
         self._current: Optional[Tuple[int, jnp.ndarray]] = None
+        self._swap_lock = threading.Lock()
+        # set by the first applied push: the push stream is authoritative
+        # and the periodic file poll stops swapping (see module docstring)
+        self._push_mode = False
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serve-ckpt-poll")
@@ -58,18 +85,36 @@ class ModelStore:
         cur = self._current
         return cur[0] if cur is not None else None
 
-    # -- the poll ------------------------------------------------------------
+    @property
+    def push_mode(self) -> bool:
+        """True once a push has been applied (file poll no longer swaps)."""
+        return self._push_mode
 
-    def poll_once(self) -> bool:
-        """Check for a newer checkpoint; swap it in.  True iff swapped."""
+    # -- the swap ------------------------------------------------------------
+
+    def _publish(self, step: int, weights, reason: str) -> None:
+        """One reference assignment + version gauge; callers hold _swap_lock."""
+        self._current = (int(step), weights)
+        if self._metrics is not None:
+            self._metrics.gauge(metrics_mod.SERVE_MODEL_VERSION).set(step)
+        log.info("serving model swapped to step %d (%d features, %s)",
+                 step, weights.shape[0], reason)
+
+    # -- the file poll -------------------------------------------------------
+
+    def poll_once(self, force: bool = False) -> bool:
+        """Check for a newer checkpoint file; swap it in.  True iff swapped.
+
+        `force` (the version-gap fallback of `apply_push`) bypasses push
+        mode AND the newer-step comparison: the file's latest snapshot
+        wins outright, whatever version the push stream left behind."""
         cur = self._current
+        if self._push_mode and not force:
+            return False
         try:
-            self._ckpt.reload()
-            step = self._ckpt.latest_step()
-            if step is None or (cur is not None and step <= cur[0]):
-                return False
-            restored = self._ckpt.restore_latest()
-            if restored is None:  # deleted between listing and restore
+            restored = self._ckpt.poll_newer(
+                None if force else (cur[0] if cur is not None else None))
+            if restored is None:
                 return False
             step, state = restored
             weights = jnp.asarray(state["weights"], dtype=jnp.float32)
@@ -79,16 +124,75 @@ class ModelStore:
             if self._metrics is not None:
                 self._metrics.counter("serve.model.reload.errors").increment()
             return False
-        self._current = (step, weights)
+        with self._swap_lock:
+            # re-check under the writer lock: the (multi-second) orbax
+            # restore above ran unlocked, and a push may have landed
+            # meanwhile — the push stream is authoritative, so an
+            # unforced file poll must never clobber it
+            now = self._current
+            if not force and (self._push_mode
+                              or (now is not None and step <= now[0])):
+                return False
+            self._publish(step, weights, reason="file reload")
         if self._metrics is not None:
             self._metrics.counter("serve.model.reload").increment()
-        log.info("serving model hot-swapped to checkpoint step %d (%d features)",
-                 step, weights.shape[0])
         return True
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_s):
             self.poll_once()
+
+    # -- push-apply (PushWeights; docs/SERVING.md "serving fleet") -----------
+
+    def apply_push(self, request) -> Tuple[bool, int]:
+        """Apply one PushWeightsRequest; returns (ok, serving_step).
+
+        Full form: the pushed tensor replaces the snapshot at the pushed
+        version unconditionally — the pusher is authoritative, which is
+        what lets a canary ROLLBACK re-install an older version (a
+        monotone guard would wedge the rollback).  Delta form: applied in
+        place iff the current snapshot IS the delta's base version;
+        anything else — empty store, missed push, restarted replica — is
+        a version gap: NACK (the pusher resends full) plus one forced
+        full-file reload so a shared checkpoint directory also heals it.
+        """
+        from distributed_sgd_tpu.rpc import codec
+
+        version = int(request.version)
+        with self._swap_lock:
+            if request.HasField("weights"):
+                w = jnp.asarray(codec.decode_tensor(request.weights),
+                                dtype=jnp.float32)
+                self._push_mode = True
+                self._publish(version, w, reason="push full")
+                if self._metrics is not None:
+                    self._metrics.counter(
+                        metrics_mod.SERVE_MODEL_PUSH_FULL).increment()
+                return True, version
+            cur = self._current
+            if (request.HasField("delta") and cur is not None
+                    and cur[0] == request.delta.base_version):
+                w = jnp.asarray(
+                    codec.apply_weight_delta(np.asarray(cur[1]), request.delta))
+                self._push_mode = True
+                self._publish(version, w, reason="push delta")
+                if self._metrics is not None:
+                    self._metrics.counter(
+                        metrics_mod.SERVE_MODEL_PUSH_DELTA).increment()
+                return True, version
+        # version gap (or a request with neither arm): count it, then fall
+        # back to a full-file reload OUTSIDE the swap lock (orbax I/O must
+        # not block concurrent pushes); whatever the directory holds is
+        # better than a replica pinned on a stale snapshot
+        if self._metrics is not None:
+            self._metrics.counter(metrics_mod.SERVE_MODEL_PUSH_GAP).increment()
+        log.warning(
+            "push version gap: delta base %s vs serving step %s — NACK + "
+            "full-file reload fallback",
+            request.delta.base_version if request.HasField("delta") else None,
+            self.step)
+        self.poll_once(force=True)
+        return False, self.step or 0
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -1,0 +1,632 @@
+"""Serving-fleet router: health-aware load balancing, hedged failover,
+canary-gated delta checkpoint distribution (docs/SERVING.md "serving
+fleet").
+
+The router speaks the SAME ``dsgd.Serving`` service as a replica
+(rpc/service.py ``_SERVE_METHODS``), so clients — and kube Services —
+cannot tell one node from a fleet:
+
+- **Predict** routes to one of N shared-nothing replicas by
+  power-of-two-choices: sample two eligible replicas, send to the one
+  with the lower ``EWMA latency x (1 + in-flight)`` score.  Eligible =
+  last ``ServeHealth`` ok AND per-replica circuit breaker not suppressing
+  (reusing rpc/service.py ``RpcPolicy``/``CircuitBreaker`` — the PR-4
+  control-plane policy).  A failed call fails over to the next-best
+  replica (the client sees ONE answer or one typed error, never a
+  dropped request); with ``hedge_ms`` set, a reply slower than the hedge
+  deadline additionally races a duplicate on the next-best replica and
+  the first success wins — the in-flight tail of a dying replica drains
+  onto the rest of the fleet.
+- **PushWeights** is the fleet's checkpoint-distribution entry point: the
+  trainer's master streams versioned weight updates (full tensor or the
+  sparse absolute-value ``WeightDelta`` codec the sync broadcast plane
+  uses, rpc/codec.py) to the ROUTER, which fans them out — through its
+  canary gate when configured.  A new version lands on the first
+  ``ceil(canary_fraction x N)`` replicas only; the router then evaluates
+  the held-out probe set against a canary replica and compares the probe
+  loss to the promoted baseline (core/loss_check.py ``LossChecker``
+  best-loss tracking, the HealthMonitor's ratio-x-best rule).  Pass ->
+  the push fans out to the rest and the version is PROMOTED; regression
+  -> the canaries are rolled back to the promoted weights, the version
+  is rejected (re-pushes NACK), and ``router.canary.rollback`` counts it.
+- **ServeHealth** aggregates the fleet (ok = any replica serving);
+  **Metrics** snapshots the router's own registry, and an optional
+  telemetry endpoint re-exports every replica's registry — scraped over
+  their ``Metrics`` RPC — as ONE merged /metrics exposition
+  (telemetry/aggregate.py), so per-replica QPS / latency quantiles /
+  ``serve.model.version`` land on a single page.
+
+Wired into main.py as ``DSGD_ROLE=route``; knobs in config.py
+(``DSGD_SERVE_TARGETS`` etc.); in-process fleet harness in
+serving/fleet.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import grpc
+import numpy as np
+
+from distributed_sgd_tpu.rpc import codec
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import (
+    RpcPolicy,
+    ServeStub,
+    add_serve_servicer,
+    new_channel,
+    new_server,
+)
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import measure
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+log = logging.getLogger("dsgd.serving")
+
+# gRPC codes that are the CALLER's fault (or backpressure), not the
+# replica's: they never feed the replica's circuit breaker, and
+# INVALID_ARGUMENT is not even worth a failover (every replica serves the
+# same model dimension).
+_NOT_PEER_FAILURE = frozenset({
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+})
+
+
+class _Replica:
+    """One backend's routing state: stub + EWMA latency + in-flight count
+    + health + the shared per-peer breaker."""
+
+    EWMA_ALPHA = 0.2  # same smoothing family as core/master._LatencyEwma
+
+    def __init__(self, host: str, port: int, policy: RpcPolicy):
+        self.host, self.port = host, int(port)
+        self.key = (host, int(port))
+        self.channel = new_channel(host, int(port))
+        self.stub = ServeStub(self.channel)
+        self.breaker = policy.breaker(self.key)
+        # optimistic prior: an unmeasured replica must be pickable, and a
+        # small prior latency lets the first real measurements dominate
+        self.ewma_s = 0.010
+        self.inflight = 0
+        self._lock = threading.Lock()
+        # healthy only after a ServeHealth returns ok=True — the router
+        # never routes to a replica it has not seen alive
+        self.healthy = False
+        self.model_step = 0
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def score(self) -> float:
+        """Power-of-two-choices score: lower is better.  EWMA latency
+        weighted by the in-flight count, so a slow replica AND a busy
+        replica both lose the coin flip."""
+        return self.ewma_s * (1.0 + self.inflight)
+
+    def begin(self) -> None:
+        with self._lock:
+            self.inflight += 1
+
+    def end(self) -> None:
+        with self._lock:
+            self.inflight -= 1
+
+    def ok(self, latency_s: float) -> None:
+        self.ewma_s += self.EWMA_ALPHA * (latency_s - self.ewma_s)
+        self.breaker.record_ok()
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+def load_probe(path: str) -> List[Tuple[np.ndarray, np.ndarray, float]]:
+    """Load a canary probe set from an .npz of padded 2-D `indices` /
+    `values` plus 1-D `labels`; zero-VALUE cells are padding (the same
+    inert-pad convention as serving/bucketing.py) and are stripped per
+    row.  Returns the [(indices, values, label)] rows the router wants."""
+    with np.load(path) as z:
+        idx, val, y = z["indices"], z["values"], z["labels"]
+    rows = []
+    for i in range(len(y)):
+        nz = val[i] != 0
+        rows.append((np.asarray(idx[i][nz], np.int32),
+                     np.asarray(val[i][nz], np.float32), float(y[i])))
+    return rows
+
+
+def probe_from_dataset(data, n: int = 64) -> List[Tuple[np.ndarray, np.ndarray, float]]:
+    """First `n` rows of a Dataset as probe rows (held-out split — the
+    canary baseline must not be the training data)."""
+    rows = []
+    for i in range(min(n, len(data))):
+        idx, val = data.indices[i], data.values[i]
+        nz = val != 0
+        rows.append((np.asarray(idx[nz], np.int32),
+                     np.asarray(val[nz], np.float32), float(data.labels[i])))
+    return rows
+
+
+class ServingRouter:
+    """N-replica Predict router + canary-gated PushWeights fan-out."""
+
+    # canary regression rule (the HealthMonitor/parity-gate family): the
+    # probe loss of a new version regresses when it exceeds
+    # max(ratio * best, best + abs_floor) — the absolute floor keeps the
+    # relative bound meaningful near zero loss (docs/COMPRESSION.md).
+    CANARY_ABS_FLOOR = 0.02
+
+    def __init__(
+        self,
+        replicas: Sequence[Tuple[str, int]],
+        port: int = 0,
+        host: str = "0.0.0.0",
+        model: str = "hinge",
+        lam: float = 1e-5,
+        canary_fraction: float = 0.0,
+        canary_ratio: float = 1.05,
+        probe: Optional[Sequence[Tuple[np.ndarray, np.ndarray, float]]] = None,
+        hedge_ms: float = 0.0,
+        health_s: float = 1.0,
+        request_timeout_s: float = 30.0,
+        policy: Optional[RpcPolicy] = None,
+        metrics=None,
+        telemetry_port: Optional[int] = None,
+        seed: int = 0,
+    ):
+        if not replicas:
+            raise ValueError("a router needs at least one replica endpoint")
+        if not 0.0 <= canary_fraction <= 1.0:
+            raise ValueError("canary_fraction must be in [0, 1]")
+        if metrics is None:
+            metrics = metrics_mod.global_metrics()
+        self.metrics = metrics
+        self._policy = policy or RpcPolicy(seed=seed, metrics=metrics)
+        self._replicas = [_Replica(h, p, self._policy) for h, p in replicas]
+        self._rng = random.Random(seed)
+        self._timeout = float(request_timeout_s)
+        self._hedge_s = max(0.0, float(hedge_ms)) / 1000.0
+        self.health_s = float(health_s)
+        self._stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="route-health")
+
+        # -- canary state (all under _push_lock) ---------------------------
+        self._push_lock = threading.Lock()
+        self.canary_fraction = float(canary_fraction)
+        self.canary_ratio = float(canary_ratio)
+        self._probe = list(probe) if probe else None
+        self._model_name, self._lam = model, float(lam)
+        self._probe_model = None  # built lazily (losses_from_margins only)
+        self._promoted_version: Optional[int] = None
+        self._w_promoted: Optional[np.ndarray] = None
+        self._rejected: set = set()
+        # probe-loss baseline across promoted versions: LossChecker's
+        # best-loss tracking (core/loss_check.py), leaky=1.0 — each
+        # version is judged on its RAW probe loss against the best ever
+        from distributed_sgd_tpu.core.loss_check import LossChecker
+
+        self._checker = LossChecker(leaky_loss=1.0)
+
+        self._server = new_server(port, host=host)
+        add_serve_servicer(self._server, self,
+                           node=f"route:{self._server.bound_port}")
+        self._node = f"route:{self._server.bound_port}"
+
+        # optional fleet telemetry endpoint: replicas' registries scraped
+        # over their Metrics RPC, merged with the router's own
+        # (telemetry/aggregate.py semantics — per-replica labels, exact
+        # cluster bucket sums)
+        self.telemetry = None
+        self.telemetry_exporter = None
+        if telemetry_port is not None:
+            from distributed_sgd_tpu.telemetry.aggregate import (
+                ClusterExporter,
+                ClusterTelemetry,
+            )
+
+            self.telemetry = ClusterTelemetry(
+                self.metrics, node=self._node, role="route")
+            members = [(r.key, r.stub) for r in self._replicas]
+            self.telemetry_exporter = ClusterExporter(
+                self.telemetry.prometheus_text, telemetry_port,
+                refresh=lambda: self.telemetry.scrape(
+                    members, self._policy, min_age_s=0.5))
+
+    # -- replica selection ---------------------------------------------------
+
+    def _eligible(self, exclude: Sequence["_Replica"] = ()) -> List["_Replica"]:
+        return [
+            r for r in self._replicas
+            if r not in exclude and r.healthy and not r.breaker.suppressed()
+        ]
+
+    def _pick(self, exclude: Sequence["_Replica"] = ()) -> Optional["_Replica"]:
+        """Power-of-two-choices over the eligible set; falls back to ANY
+        non-excluded replica when the eligible set is empty (a request in
+        hand beats a perfect rotation — the call itself is the probe)."""
+        pool = self._eligible(exclude)
+        if not pool:
+            pool = [r for r in self._replicas if r not in exclude]
+        if not pool:
+            return None
+        if len(pool) == 1:
+            return pool[0]
+        a, b = self._rng.sample(pool, 2)
+        return a if a.score() <= b.score() else b
+
+    # -- the data plane ------------------------------------------------------
+
+    def Predict(self, request, context):  # noqa: N802 - gRPC method name
+        tried: List[_Replica] = []
+        last: Optional[grpc.RpcError] = None
+        with measure.span("route.predict", metrics=self.metrics, root=False):
+            for _attempt in range(len(self._replicas)):
+                r = self._pick(exclude=tried)
+                if r is None:
+                    break
+                try:
+                    return self._call_predict(r, request)
+                except grpc.RpcError as e:
+                    if e.code() == grpc.StatusCode.INVALID_ARGUMENT:
+                        # caller error: every replica would reject it too
+                        context.abort(e.code(), e.details())
+                    last = e
+                    tried.append(r)
+                    self.metrics.counter(metrics_mod.ROUTER_RETRIES).increment()
+        if last is not None:
+            context.abort(last.code() or grpc.StatusCode.UNAVAILABLE,
+                          f"all replicas failed; last: {last.details()}")
+        context.abort(grpc.StatusCode.UNAVAILABLE,
+                      "no serving replica available")
+
+    def _call_predict(self, r: _Replica, request):
+        """One routed attempt, hedged past the tail when configured.
+        Raises grpc.RpcError on failure (the failover loop owns retries);
+        feeds the replica's breaker and latency EWMA."""
+        t0 = time.perf_counter()
+        r.begin()
+        hedge: Optional[Tuple[_Replica, object]] = None
+        try:
+            fut = r.stub.Predict.future(request, timeout=self._timeout)
+            if self._hedge_s > 0:
+                try:
+                    reply = fut.result(timeout=self._hedge_s)
+                    r.ok(time.perf_counter() - t0)
+                    return reply
+                except grpc.FutureTimeoutError:
+                    h = self._pick(exclude=(r,))
+                    hfut = None
+                    t_hedge = time.perf_counter()
+                    if h is not None:
+                        h.begin()
+                        hedge = (h, hfut)  # end() in finally even if
+                        try:               # the future never constructs
+                            hfut = h.stub.Predict.future(
+                                request, timeout=self._timeout)
+                        except Exception:  # noqa: BLE001 - channel closed
+                            hfut = None
+                    if hfut is not None:
+                        self.metrics.counter(
+                            metrics_mod.ROUTER_HEDGES).increment()
+                        winner, reply = self._race([(r, fut), (h, hfut)])
+                        # each attempt's EWMA sees ITS OWN latency: a
+                        # winning hedge charged from the primary's start
+                        # would inflate the fast replica by hedge_ms and
+                        # steer p2c away from it
+                        winner.ok(time.perf_counter()
+                                  - (t_hedge if winner is h else t0))
+                        if winner is h:
+                            self.metrics.counter(
+                                metrics_mod.ROUTER_HEDGE_WINS).increment()
+                        return reply
+            reply = fut.result()  # raises the RpcError on failure
+            r.ok(time.perf_counter() - t0)
+            return reply
+        except grpc.RpcError as e:
+            if e.code() not in _NOT_PEER_FAILURE:
+                r.breaker.record_failure()
+            raise
+        finally:
+            r.end()
+            if hedge is not None:
+                hedge[0].end()
+
+    @staticmethod
+    def _race(pairs):
+        """(winner, reply) of the first future to SUCCEED; the loser is
+        cancelled.  When every future fails, re-raises the PRIMARY's
+        error (pairs[0]) — the failover loop then excludes the primary."""
+        ev = threading.Event()
+        for _rep, f in pairs:
+            f.add_done_callback(lambda _f: ev.set())
+        while True:
+            done = [(rep, f) for rep, f in pairs if f.done()]
+            for rep, f in done:
+                if not f.cancelled() and f.exception() is None:
+                    for _rep2, f2 in pairs:
+                        if f2 is not f:
+                            f2.cancel()
+                    return rep, f.result()
+            if len(done) == len(pairs):
+                raise pairs[0][1].exception()
+            ev.wait(0.05)
+            ev.clear()
+
+    # -- health / draining ---------------------------------------------------
+
+    def _health_pass(self) -> None:
+        for r in self._replicas:
+            try:
+                h = r.stub.ServeHealth(
+                    pb.Empty(), timeout=min(self._policy.deadline_s,
+                                            max(self.health_s, 0.1)))
+                now_ok = bool(h.ok)
+                r.model_step = int(h.model_step)
+                r.breaker.record_ok()
+            except grpc.RpcError:
+                now_ok = False
+                r.breaker.record_failure()
+            if r.healthy and not now_ok:
+                # drain: no NEW picks route here; in-flight calls finish
+                # (or fail over), so the drain drops zero requests
+                self.metrics.counter(metrics_mod.ROUTER_DRAINED).increment()
+                flight.record("router.replica.drained", peer=r.endpoint)
+                log.warning("replica %s drained (health failed or not ready)",
+                            r.endpoint)
+            r.healthy = now_ok
+        self.metrics.gauge(metrics_mod.ROUTER_ELIGIBLE).set(
+            len(self._eligible()))
+
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_s):
+            self._health_pass()
+
+    # -- checkpoint distribution + canary (PushWeights) ----------------------
+
+    def _canary_count(self) -> int:
+        if self.canary_fraction <= 0 or self._probe is None:
+            return 0
+        return min(len(self._replicas),
+                   max(1, math.ceil(self.canary_fraction * len(self._replicas))))
+
+    def _resolve_weights(self, request) -> Optional[np.ndarray]:
+        """The pushed version's FULL weight vector, reconstructed on the
+        router's own promoted cache (the rollback needs it); None = the
+        delta's base is not our promoted version (NACK: the pusher
+        resends full, exactly like a replica's version gap)."""
+        if request.HasField("weights"):
+            return codec.decode_tensor(request.weights)
+        if (request.HasField("delta") and self._w_promoted is not None
+                and self._promoted_version == request.delta.base_version):
+            return codec.apply_weight_delta(self._w_promoted, request.delta)
+        return None
+
+    def _fan_out(self, request, replicas: Sequence["_Replica"]) -> int:
+        """Concurrent PushWeights to `replicas`; returns how many acked ok.
+        Send bytes are accounted per DELIVERED send (the comms.* send-side
+        pattern; a transport failure ships nothing and must not pad the
+        wire-savings ratio the serve bench gates); a NACK counts
+        serve.push.nack (the replica already fell back to a full-file
+        reload on its side)."""
+        futs = []
+        form = "delta" if request.HasField("delta") else "full"
+        dense = 4 * (len(self._w_promoted) if self._w_promoted is not None
+                     else request.weights.size)
+        for r in replicas:
+            try:
+                futs.append((r, r.stub.PushWeights.future(
+                    request, timeout=self._policy.deadline_s)))
+            except Exception:  # noqa: BLE001 - channel closed under us
+                self.metrics.counter(metrics_mod.SERVE_PUSH_ERRORS).increment()
+        acked = 0
+        for r, f in futs:
+            try:
+                reply = f.result()
+                metrics_mod.record_push(self.metrics, form,
+                                        request.ByteSize(), dense)
+                if reply.ok:
+                    acked += 1
+                else:
+                    self.metrics.counter(
+                        metrics_mod.SERVE_PUSH_NACK).increment()
+            except grpc.RpcError:
+                self.metrics.counter(metrics_mod.SERVE_PUSH_ERRORS).increment()
+                r.breaker.record_failure()
+        return acked
+
+    def _probe_loss(self, canaries: Sequence["_Replica"],
+                    version: int) -> Optional[float]:
+        """Mean probe-set loss served by a canary replica at `version`;
+        None when no canary answered the whole probe (treated as a failed
+        canary by the caller)."""
+        if self._probe_model is None:
+            from distributed_sgd_tpu.models.linear import make_model
+
+            # losses_from_margins is all the router needs: margin -> loss
+            # is dimension-free, so n_features=1 and no regularizer
+            self._probe_model = make_model(
+                self._model_name, self._lam, 1, regularizer="none")
+        import jax.numpy as jnp
+
+        for r in canaries:
+            margins, ys = [], []
+            try:
+                for idx, val, y in self._probe:
+                    reply = r.stub.Predict(
+                        pb.PredictRequest(indices=idx, values=val),
+                        timeout=self._policy.deadline_s)
+                    if reply.model_step != version:
+                        raise ValueError(
+                            f"canary {r.endpoint} answered from step "
+                            f"{reply.model_step}, not {version}")
+                    margins.append(reply.margin)
+                    ys.append(y)
+            except (grpc.RpcError, ValueError) as e:
+                log.warning("canary probe against %s failed: %s", r.endpoint, e)
+                continue
+            losses = self._probe_model.losses_from_margins(
+                jnp.asarray(margins, jnp.float32), jnp.asarray(ys, jnp.float32))
+            return float(jnp.mean(losses))
+        return None
+
+    def _regressed(self, loss: float) -> bool:
+        if not np.isfinite(loss):
+            return True  # NaN/Inf probe margins: a genuinely poisoned model
+        best = self._checker.best_loss
+        if best == float("inf"):
+            return False  # no baseline yet: first version promotes
+        return loss > max(self.canary_ratio * best, best + self.CANARY_ABS_FLOOR)
+
+    def _promote(self, version: int, w: np.ndarray,
+                 loss: Optional[float]) -> None:
+        self._promoted_version = int(version)
+        self._w_promoted = np.asarray(w, np.float32)
+        if loss is not None and np.isfinite(loss):
+            self._checker.check(loss, 0.0, self._w_promoted, step=version)
+            self.metrics.gauge(metrics_mod.ROUTER_CANARY_LOSS).set(loss)
+        self.metrics.counter(metrics_mod.ROUTER_CANARY_PROMOTED).increment()
+        log.info("version %d promoted fleet-wide (probe loss %s)",
+                 version, f"{loss:.6f}" if loss is not None else "n/a")
+
+    def _repin(self, canaries: Sequence["_Replica"]) -> None:
+        """Re-install the promoted weights on the canary subset (a full
+        push — apply_push is authoritative at any version)."""
+        req = pb.PushWeightsRequest(version=self._promoted_version)
+        req.weights.CopyFrom(codec.encode_tensor(self._w_promoted))
+        self._fan_out(req, canaries)
+
+    def _rollback(self, version: int, canaries: Sequence["_Replica"],
+                  loss: float) -> None:
+        self._rejected.add(int(version))
+        self.metrics.counter(metrics_mod.ROUTER_CANARY_ROLLBACK).increment()
+        flight.record("router.canary.rollback", version=int(version),
+                      probe_loss=loss, baseline=self._checker.best_loss)
+        self._repin(canaries)
+        log.warning(
+            "version %d ROLLED BACK (probe loss %.6f vs baseline %.6f): "
+            "canaries re-pinned to promoted version %d",
+            version, loss, self._checker.best_loss, self._promoted_version)
+
+    def PushWeights(self, request, context):  # noqa: N802 - gRPC method name
+        with self._push_lock:
+            version = int(request.version)
+            current = self._promoted_version or 0
+            if version in self._rejected:
+                # a rejected version stays rejected: the trainer's next
+                # checkpoint gets a fresh canary instead
+                return pb.PushWeightsReply(ok=False, model_step=current)
+            w_new = self._resolve_weights(request)
+            if w_new is None:
+                self.metrics.counter(metrics_mod.SERVE_PUSH_NACK).increment()
+                return pb.PushWeightsReply(ok=False, model_step=current)
+            # reply `ok` is the ROUTER's accept/reject decision ONLY
+            # (promoted vs canary-rejected/version-gap) — NOT fan-out
+            # completeness: a down replica is the router's problem (its
+            # health loop drains it, and the replica's own version-gap
+            # file fallback heals it on rejoin).  Folding partial fan-out
+            # failure into ok would make the pusher treat every push
+            # during one replica's outage as a NACK — full-form resends
+            # of already-promoted versions, re-running the canary probe
+            # and forfeiting the delta savings the feature exists for.
+            n_canary = self._canary_count()
+            gated = n_canary > 0 and self._promoted_version is not None
+            if not gated:
+                acked = self._fan_out(request, self._replicas)
+                loss = (self._probe_loss(self._eligible() or self._replicas,
+                                         version)
+                        if self._probe is not None else None)
+                self._promote(version, w_new, loss)
+            else:
+                # canaries come from the ELIGIBLE (healthy, breaker-quiet)
+                # set first: a statically-indexed canary that happens to be
+                # the dead replica would make every probe unevaluable and
+                # freeze fleet updates while 2/3 of the fleet is healthy
+                pool = self._eligible() or list(self._replicas)
+                canaries = pool[:n_canary]
+                rest = [r for r in self._replicas if r not in canaries]
+                acked = self._fan_out(request, canaries)
+                loss = self._probe_loss(canaries, version)
+                if loss is None:
+                    # the probe could not RUN (canaries unreachable):
+                    # re-pin the canaries but do NOT reject the version —
+                    # rejection is a verdict, and no verdict was reached;
+                    # the pusher's next attempt retries on a fresh set
+                    self._repin(canaries)
+                    self.metrics.counter(
+                        metrics_mod.SERVE_PUSH_ERRORS).increment()
+                    log.warning("version %d not promoted: canary probe "
+                                "unevaluable (no canary answered); will "
+                                "retry on the next push", version)
+                    return pb.PushWeightsReply(ok=False, model_step=current)
+                if self._regressed(loss):
+                    self._rollback(version, canaries, loss)
+                    return pb.PushWeightsReply(ok=False, model_step=current)
+                acked += self._fan_out(request, rest) if rest else 0
+                self._promote(version, w_new, loss)
+            if acked < len(self._replicas):
+                log.warning("version %d promoted with %d/%d replicas acked "
+                            "(the rest heal via gap fallback)",
+                            version, acked, len(self._replicas))
+            return pb.PushWeightsReply(ok=True, model_step=version)
+
+    # -- fleet health + telemetry -------------------------------------------
+
+    def ServeHealth(self, request, context):  # noqa: N802 - gRPC method name
+        serving = [r for r in self._replicas if r.healthy]
+        step = (self._promoted_version
+                if self._promoted_version is not None
+                else max((r.model_step for r in serving), default=0))
+        return pb.ServeHealthReply(
+            ok=bool(serving),
+            model_step=int(step),
+            queue_depth=sum(r.inflight for r in self._replicas),
+        )
+
+    def Metrics(self, request, context):  # noqa: N802 - gRPC method name
+        from distributed_sgd_tpu.telemetry.aggregate import snapshot_metrics
+
+        return snapshot_metrics(self.metrics, role="route", node=self._node)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def bound_port(self) -> int:
+        return self._server.bound_port
+
+    def start(self) -> "ServingRouter":
+        self._health_pass()  # route nothing before one synchronous look
+        self._health_thread.start()
+        self._server.start()
+        if self.telemetry_exporter is not None:
+            self.telemetry_exporter.start()
+        log.info("routing on :%d over %d replicas (%s); canary=%g hedge=%gms",
+                 self.bound_port, len(self._replicas),
+                 ", ".join(r.endpoint for r in self._replicas),
+                 self.canary_fraction, self._hedge_s * 1e3)
+        return self
+
+    def await_termination(self) -> None:
+        self._server.wait_for_termination()
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._stop.set()
+        self._server.stop(grace).wait()
+        if self._health_thread.is_alive():
+            self._health_thread.join(timeout=self.health_s + 1.0)
+        if self.telemetry_exporter is not None:
+            self.telemetry_exporter.stop()
+        for r in self._replicas:
+            r.close()
+
+    def __enter__(self) -> "ServingRouter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -198,6 +198,16 @@ class ServingServicer:
             queue_depth=self._batcher.depth,
         )
 
+    def PushWeights(self, request, context):  # noqa: N802 - gRPC method name
+        # delta checkpoint distribution (docs/SERVING.md "serving fleet"):
+        # the store applies the update in place — the replica stays hot,
+        # in-flight batches finish on the snapshot they started on, and
+        # the NEXT flush runs the pushed weights.  ok=False = version gap
+        # (the pusher resends full; the store already fell back to a
+        # full-file reload).
+        ok, step = self._store.apply_push(request)
+        return pb.PushWeightsReply(ok=ok, model_step=step)
+
     def Metrics(self, request, context):  # noqa: N802 - gRPC method name
         # cluster telemetry scrape (telemetry/aggregate.py): lets an
         # aggregator fold serving replicas into the one cluster view —

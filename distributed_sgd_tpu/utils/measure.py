@@ -41,6 +41,7 @@ SPAN_NAME_ALLOWLIST = frozenset({
     "serve.predict.decode",
     "serve.predict.queue",
     "serve.batch.execute",
+    "route.predict",
     "ckpt.save",
     "ckpt.restore",
     "trainer.epoch",

@@ -433,6 +433,47 @@ HEALTH_DRAIN_BACKLOG = "health.drain.backlog"       # gauge: async inbox depth (
 HEALTH_LOSS_EWMA = "health.loss.ewma"               # gauge: watchdog's smoothed loss
 HEALTH_TRIPPED = "health.tripped"                   # counter: watchdog trips
 
+# -- serving fleet (serving/router.py + serving/push.py; docs/SERVING.md) -----
+#
+# Checkpoint-distribution accounting follows the master.sync.bcast.* /
+# comms.* pattern: the PUSHER (the trainer master's distributor, or the
+# router re-pushing on canary rollback) counts send-side only, so an
+# in-process fleet sharing a registry never double-counts.  `bytes` is the
+# actual serialized PushWeightsRequest size; `bytes_full_equiv` is what the
+# same update would have cost as one full dense tensor per target — the
+# denominator of the fleet's wire-savings ratio (benches/bench_serve.py).
+SERVE_PUSH_BYTES = "serve.push.bytes"                # counter: wire bytes sent
+SERVE_PUSH_FULL_EQUIV = "serve.push.bytes_full_equiv"  # counter: 4*dim/target baseline
+SERVE_PUSH_FULL = "serve.push.full"                  # counter: full-tensor pushes
+SERVE_PUSH_DELTA = "serve.push.delta"                # counter: sparse delta pushes
+SERVE_PUSH_NACK = "serve.push.nack"                  # counter: version-gap nacks seen
+SERVE_PUSH_ERRORS = "serve.push.errors"              # counter: failed push RPCs
+# replica-side push application (serving/model_store.py apply_push)
+SERVE_MODEL_PUSH_FULL = "serve.model.push.full"      # counter: full pushes applied
+SERVE_MODEL_PUSH_DELTA = "serve.model.push.delta"    # counter: deltas applied in place
+SERVE_MODEL_PUSH_GAP = "serve.model.push.gap"        # counter: gaps -> file fallback
+SERVE_MODEL_VERSION = "serve.model.version"          # gauge: checkpoint step serving NOW
+# router data plane (serving/router.py)
+ROUTER_RETRIES = "router.predict.retries"            # counter: failovers to another replica
+ROUTER_HEDGES = "router.predict.hedges"              # counter: tail hedges issued
+ROUTER_HEDGE_WINS = "router.predict.hedge_wins"      # counter: hedge answered first
+ROUTER_DRAINED = "router.replica.drained"            # counter: healthy->drained transitions
+ROUTER_ELIGIBLE = "router.replica.eligible"          # gauge: replicas in rotation
+ROUTER_CANARY_PROMOTED = "router.canary.promoted"    # counter: versions promoted fleet-wide
+ROUTER_CANARY_ROLLBACK = "router.canary.rollback"    # counter: versions rolled back
+ROUTER_CANARY_LOSS = "router.canary.probe_loss"      # gauge: last probe-set loss
+
+
+def record_push(metrics: "Metrics", form: str, wire_bytes: int,
+                dense_bytes: int) -> None:
+    """Account one PushWeights send: `form` is 'full' | 'delta';
+    `dense_bytes` is the full-tensor-per-target baseline the delta saved
+    against (the analogue of record_wire's dense equivalent)."""
+    metrics.counter(SERVE_PUSH_BYTES).increment(int(wire_bytes))
+    metrics.counter(SERVE_PUSH_FULL_EQUIV).increment(int(dense_bytes))
+    metrics.counter(f"serve.push.{form}").increment()
+
+
 # which sparse-scatter formulation the process's kernels run (DSGD_SCATTER,
 # ops/mxu.py; ROADMAP item 2 follow-up): gauge value indexes
 # mxu.SCATTER_FORMULATIONS ('onehot'=0, 'segment'=1, 'twostage'=2,

@@ -9,6 +9,14 @@ checkpointed weights, and a second checkpoint demonstrates hot-reload
 without restarting the server.
 
     python examples/serve_predict.py [n_samples]
+
+Fleet mode (docs/SERVING.md "serving fleet"): set DSGD_SERVE_ROUTER to a
+router's host:port and the demo drives THAT endpoint instead of starting a
+local server — same Predict checks, with the second checkpoint reaching
+the fleet through its PushWeights distribution path (the router/replicas
+must share this process's checkpoint directory, or be fed by a
+CheckpointDistributor watching it).  The dsgd.Serving surface is identical
+either way, which is the point.
 """
 
 import os
@@ -55,16 +63,27 @@ def main(n: int = 5_000, max_epochs: int = 2, n_requests: int = 32) -> float:
     print(f"trained {res.epochs_run} epochs, test_loss={res.test_losses[-1]:.4f}")
 
     # -- serve it -----------------------------------------------------------
+    # DSGD_SERVE_ROUTER=host:port -> drive an already-running fleet router
+    # instead of a local single-node server (env-only switch; the wire
+    # surface is identical — see the module docstring)
+    router = os.environ.get("DSGD_SERVE_ROUTER")
     metrics = Metrics()
-    server = ServingServer(
-        ckpt_dir, model="hinge", port=0, host="127.0.0.1",
-        max_batch=16, max_delay_ms=5.0, queue_depth=128,
-        ckpt_poll_s=0.2, metrics=metrics,
-    ).start()
-    channel = new_channel("127.0.0.1", server.bound_port)
+    server = None
+    if router:
+        from distributed_sgd_tpu.serving.push import parse_targets
+
+        channel = new_channel(*parse_targets(router)[0])
+    else:
+        server = ServingServer(
+            ckpt_dir, model="hinge", port=0, host="127.0.0.1",
+            max_batch=16, max_delay_ms=5.0, queue_depth=128,
+            ckpt_poll_s=0.2, metrics=metrics,
+        ).start()
+        channel = new_channel("127.0.0.1", server.bound_port)
     stub = ServeStub(channel)
     health = stub.ServeHealth(pb.Empty(), timeout=5)
-    print(f"serving on :{server.bound_port}, model step {health.model_step}")
+    where = router or f":{server.bound_port}"
+    print(f"serving on {where}, model step {health.model_step}")
 
     # -- concurrent Predicts, checked against direct model math -------------
     rows = [(train.indices[i], train.values[i]) for i in range(n_requests)]
@@ -94,10 +113,11 @@ def main(n: int = 5_000, max_epochs: int = 2, n_requests: int = 32) -> float:
     assert not rpc_errors, f"predict RPCs failed: {rpc_errors[:3]}"
     assert len(answered) == n_requests
     assert not mismatches, f"served answers diverged: {mismatches[:3]}"
-    batch_hist = metrics.histogram("serve.batch.size")
-    print(f"{n_requests} predicts over {batch_hist.count} micro-batches "
-          f"(max batch {batch_hist.max:.0f}, "
-          f"p50 latency {metrics.histogram('serve.predict.duration').quantile(0.5) * 1e3:.2f} ms)")
+    if server is not None:
+        batch_hist = metrics.histogram("serve.batch.size")
+        print(f"{n_requests} predicts over {batch_hist.count} micro-batches "
+              f"(max batch {batch_hist.max:.0f}, "
+              f"p50 latency {metrics.histogram('serve.predict.duration').quantile(0.5) * 1e3:.2f} ms)")
 
     # -- hot-reload: save new weights, server picks them up, no restart -----
     step0 = health.model_step
@@ -105,7 +125,15 @@ def main(n: int = 5_000, max_epochs: int = 2, n_requests: int = 32) -> float:
     ckpt2.save(int(step0) + 1, w * 2.0)
     ckpt2.close()
     deadline = time.time() + 15
-    while time.time() < deadline and server.store.step != int(step0) + 1:
+
+    def serving_step():
+        # local mode watches the store directly; router mode asks the
+        # fleet's aggregate ServeHealth over the wire
+        if server is not None:
+            return server.store.step
+        return stub.ServeHealth(pb.Empty(), timeout=5).model_step
+
+    while time.time() < deadline and serving_step() != int(step0) + 1:
         time.sleep(0.05)
     reply = stub.Predict(
         pb.PredictRequest(indices=rows[0][0][:1], values=rows[0][1][:1]), timeout=30)
@@ -113,11 +141,12 @@ def main(n: int = 5_000, max_epochs: int = 2, n_requests: int = 32) -> float:
     assert reply.model_step == int(step0) + 1
 
     channel.close()
-    server.stop()
+    if server is not None:
+        server.stop()
     import shutil
 
     shutil.rmtree(ckpt_dir, ignore_errors=True)
-    return float(batch_hist.max)
+    return float(metrics.histogram("serve.batch.size").max) if server is not None else 1.0
 
 
 if __name__ == "__main__":

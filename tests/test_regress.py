@@ -190,6 +190,24 @@ def test_acc_gates_up_with_class_band():
     assert ok == []
 
 
+def test_latency_quantiles_gate_with_their_own_band():
+    """Serve-bench latency rows (`*_p50_s` / `*_p99_s`) are a class of
+    their own: lower-is-better like any `_s` metric, gated at 50% — a
+    doubled p99 (a routing/batching break) fails, scheduler jitter on a
+    shared host does not."""
+    assert regress.direction("predict_p99_s") == "down"
+    assert regress.tolerance_for("predict_p99_s") == 0.50
+    assert regress.tolerance_for("predict_p50_s", 0.35) == 0.50
+    hist = [{"metric": "serve_fleet", "predict_p99_s": 0.040}] * 3
+    regs, lines = regress.check(
+        {"metric": "serve_fleet", "predict_p99_s": 0.085}, hist, tolerance=0.35)
+    assert regs == ["predict_p99_s"]  # +112%: a real tail regression
+    assert any("tol 50%" in ln for ln in lines)
+    ok, _ = regress.check(
+        {"metric": "serve_fleet", "predict_p99_s": 0.055}, hist, tolerance=0.35)
+    assert ok == []  # +37%: shared-host tail noise stays inside the band
+
+
 def test_chaos_series_loss_keeps_the_timing_tolerance():
     """Chaos/quorum losses depend on which replies beat a wall-clock soft
     deadline, so bench_chaos's OWN in-run parity bound (~12%) is the real

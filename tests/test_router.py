@@ -1,0 +1,575 @@
+"""Serving fleet (docs/SERVING.md "serving fleet"): delta checkpoint
+distribution (ModelStore push-apply == full reload bit-identical, version
+gap -> full-file fallback, pusher delta/full/nack choice, distributor
+watch), the router's health-aware balancing + failover with zero dropped
+requests, canary promotion/rollback e2e, and the knobs-off guarantees —
+single-node serving wire and ModelStore behavior byte-identical to the
+pre-fleet subsystem."""
+
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.rpc import codec
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import ServeStub, new_channel
+from distributed_sgd_tpu.utils import metrics as mm
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+
+def _save(path, step, w):
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(path))
+    ck.save(step, w)
+    ck.close()
+
+
+def _store(path, metrics=None):
+    from distributed_sgd_tpu.serving.model_store import ModelStore
+
+    return ModelStore(str(path), poll_s=30.0, metrics=metrics)
+
+
+def _push_full(version, w):
+    req = pb.PushWeightsRequest(version=version)
+    req.weights.CopyFrom(codec.encode_tensor(np.asarray(w, np.float32)))
+    return req
+
+
+def _push_delta(version, w_new, w_prev, base):
+    req = pb.PushWeightsRequest(version=version)
+    delta = codec.encode_weight_delta(
+        np.asarray(w_new, np.float32), np.asarray(w_prev, np.float32), base)
+    assert delta is not None, "test update too dense for the delta form"
+    req.delta.CopyFrom(delta)
+    return req
+
+
+# -- knobs-off byte-identity (the per-subsystem invariant) --------------------
+
+
+def test_knobs_off_serving_wire_byte_identical_to_pre_fleet():
+    """The fleet adds ONLY new messages/methods: the single-node wire forms
+    are frozen — field lists exact, and sample serializations equal the
+    hand-packed pre-fleet bytes (proto3 canonical encoding)."""
+    assert [f.name for f in pb.PredictRequest.DESCRIPTOR.fields] == [
+        "indices", "values"]
+    assert [f.name for f in pb.PredictReply.DESCRIPTOR.fields] == [
+        "prediction", "margin", "model_step"]
+    assert [f.name for f in pb.ServeHealthReply.DESCRIPTOR.fields] == [
+        "ok", "model_step", "queue_depth"]
+    # hand-packed expectations (what the PR-1 messages serialized to)
+    req = pb.PredictRequest(indices=[3, 5], values=[1.5])
+    assert req.SerializeToString() == (
+        b"\x0a\x02\x03\x05" + b"\x12\x04" + struct.pack("<f", 1.5))
+    reply = pb.PredictReply(prediction=1.0, margin=-2.0, model_step=3)
+    assert reply.SerializeToString() == (
+        b"\x0d" + struct.pack("<f", 1.0) + b"\x15" + struct.pack("<f", -2.0)
+        + b"\x18\x03")
+    health = pb.ServeHealthReply(ok=True, model_step=7, queue_depth=2)
+    assert health.SerializeToString() == b"\x08\x01\x10\x07\x18\x02"
+    # and the new surface exists, separately
+    assert [f.name for f in pb.PushWeightsRequest.DESCRIPTOR.fields] == [
+        "version", "weights", "delta"]
+    assert [f.name for f in pb.PushWeightsReply.DESCRIPTOR.fields] == [
+        "ok", "model_step"]
+
+
+def test_knobs_off_config_is_single_node_and_store_never_push_mode(tmp_path):
+    from distributed_sgd_tpu.config import Config
+
+    cfg = Config()
+    assert (cfg.serve_replicas, cfg.serve_targets, cfg.serve_push,
+            cfg.serve_canary, cfg.serve_probe, cfg.serve_hedge_ms) == (
+        0, None, None, 0.0, None, 0.0)
+    # ModelStore with no push traffic behaves exactly as before: file polls
+    # swap, push mode stays off
+    w1 = np.arange(8, dtype=np.float32)
+    _save(tmp_path, 1, w1)
+    store = _store(tmp_path)
+    assert not store.push_mode
+    _save(tmp_path, 2, w1 * 2)
+    assert store.poll_once()
+    assert store.step == 2 and not store.push_mode
+    store.stop()
+
+
+def test_fleet_config_validation():
+    from distributed_sgd_tpu.config import Config
+    from distributed_sgd_tpu.serving.push import parse_targets
+
+    with pytest.raises(ValueError, match="SERVE_TARGETS"):
+        Config(role_override="route")
+    with pytest.raises(ValueError, match="host:port"):
+        Config(role_override="route", serve_targets="nonsense")
+    with pytest.raises(ValueError, match="serve_canary"):
+        Config(serve_canary=1.5)
+    with pytest.raises(ValueError, match="CHECKPOINT_DIR"):
+        Config(serve_push="127.0.0.1:4100")
+    with pytest.raises(ValueError, match="serve_hedge_ms"):
+        Config(serve_hedge_ms=-1)
+    # an armed canary with no probe would silently gate nothing on the
+    # env-driven roles — the pairing is a construction-time error there
+    with pytest.raises(ValueError, match="SERVE_PROBE"):
+        Config(role_override="route", serve_targets="a:1", serve_canary=0.5)
+    cfg = Config(role_override="route", serve_targets="a:1, b:2")
+    assert cfg.role == "route"
+    assert parse_targets(cfg.serve_targets) == [("a", 1), ("b", 2)]
+
+
+# -- ModelStore push-apply ----------------------------------------------------
+
+
+def test_delta_apply_equals_full_file_reload_bit_identical(tmp_path):
+    """The acceptance item: a replica that followed the push stream (full
+    v1 + delta v2) holds EXACTLY the weights a replica that full-file
+    reloaded v2 holds — bit-for-bit, because WeightDelta assigns absolute
+    values."""
+    rng = np.random.default_rng(0)
+    w1 = rng.normal(size=256).astype(np.float32)
+    w2 = w1.copy()
+    w2[rng.choice(256, size=17, replace=False)] = rng.normal(size=17).astype(
+        np.float32)
+
+    file_dir, push_dir = tmp_path / "file", tmp_path / "push"
+    _save(file_dir, 1, w1)
+    _save(file_dir, 2, w2)
+    reloaded = _store(file_dir)
+    assert reloaded.step == 2
+
+    _save(push_dir, 1, w1)  # cold start from the same v1
+    m = Metrics()
+    pushed = _store(push_dir, metrics=m)
+    ok, step = pushed.apply_push(_push_delta(2, w2, w1, base=1))
+    assert ok and step == 2 and pushed.push_mode
+    np.testing.assert_array_equal(np.asarray(pushed.get()[1]),
+                                  np.asarray(reloaded.get()[1]))
+    assert m.counter(mm.SERVE_MODEL_PUSH_DELTA).value == 1
+    assert m.gauge(mm.SERVE_MODEL_VERSION).value == 2.0
+    reloaded.stop()
+    pushed.stop()
+
+
+def test_version_gap_nacks_and_falls_back_to_full_file_reload(tmp_path):
+    w5 = np.full(16, 5.0, np.float32)
+    _save(tmp_path, 5, w5)
+    m = Metrics()
+    store = _store(tmp_path, metrics=m)
+    assert store.step == 5
+
+    ok, step = store.apply_push(_push_full(7, w5 * 7))
+    assert ok and step == 7 and store.push_mode
+
+    # the trainer kept checkpointing to the shared dir meanwhile
+    w9 = np.full(16, 9.0, np.float32)
+    _save(tmp_path, 9, w9)
+    # a delta based on a version this replica never saw: NACK + the file
+    # fallback recovers the newest on-disk snapshot
+    w10 = w9.copy()
+    w10[0] = -1.0
+    gap = _push_delta(11, w10, w9, base=10)
+    ok, step = store.apply_push(gap)
+    assert not ok
+    assert m.counter(mm.SERVE_MODEL_PUSH_GAP).value == 1
+    assert store.step == 9
+    np.testing.assert_array_equal(np.asarray(store.get()[1]), w9)
+    store.stop()
+
+
+def test_push_mode_suspends_file_poll_until_forced(tmp_path):
+    """After a push the file poll must NOT override the push stream — the
+    directory may hold exactly the version a canary rollback rejected."""
+    _save(tmp_path, 1, np.ones(8, np.float32))
+    store = _store(tmp_path)
+    store.apply_push(_push_full(3, np.full(8, 3.0, np.float32)))
+    _save(tmp_path, 10, np.full(8, 10.0, np.float32))
+    assert not store.poll_once()  # push mode: the file does not win
+    assert store.step == 3
+    assert store.poll_once(force=True)  # the explicit fallback does
+    assert store.step == 10
+    store.stop()
+
+
+def test_rollback_push_reinstalls_an_older_version(tmp_path):
+    """A full push is authoritative even when its version is LOWER than
+    the serving step — that is what a canary rollback is."""
+    _save(tmp_path, 1, np.ones(8, np.float32))
+    store = _store(tmp_path)
+    store.apply_push(_push_full(4, np.full(8, 4.0, np.float32)))
+    ok, step = store.apply_push(_push_full(2, np.full(8, 2.0, np.float32)))
+    assert ok and step == 2 and store.step == 2
+    np.testing.assert_array_equal(np.asarray(store.get()[1]),
+                                  np.full(8, 2.0, np.float32))
+    store.stop()
+
+
+# -- hot swap under concurrent traffic (push path) ---------------------------
+
+
+def test_push_hot_swap_mid_traffic_no_failed_requests(tmp_path):
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    w1 = np.ones(32, np.float32)
+    _save(tmp_path, 1, w1)
+    m = Metrics()
+    server = ServingServer(str(tmp_path), model="hinge", port=0,
+                           host="127.0.0.1", max_batch=8, max_delay_ms=2.0,
+                           queue_depth=64, ckpt_poll_s=30.0, metrics=m).start()
+    channel = new_channel("127.0.0.1", server.bound_port)
+    stub = ServeStub(channel)
+    stop = threading.Event()
+    failures, steps_seen = [], set()
+
+    def traffic():
+        while not stop.is_set():
+            try:
+                r = stub.Predict(
+                    pb.PredictRequest(indices=[3], values=[1.0]), timeout=15)
+                steps_seen.add(r.model_step)
+            except Exception as e:  # noqa: BLE001 - collected for the assert
+                failures.append(e)
+
+    threads = [threading.Thread(target=traffic) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)
+    # stream v2 as a sparse delta THROUGH the wire, mid-traffic
+    w2 = w1.copy()
+    w2[3] = -5.0
+    reply = stub.PushWeights(_push_delta(2, w2, w1, base=1), timeout=5)
+    assert reply.ok and reply.model_step == 2
+    deadline = time.time() + 10
+    while time.time() < deadline and 2 not in steps_seen:
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not failures, failures[:3]
+    assert {1, 2} <= steps_seen  # served from both versions, no restart
+    r = stub.Predict(pb.PredictRequest(indices=[3], values=[1.0]), timeout=15)
+    assert r.model_step == 2 and r.margin == pytest.approx(-5.0, abs=1e-5)
+    channel.close()
+    server.stop()
+
+
+# -- WeightPusher / CheckpointDistributor ------------------------------------
+
+
+@pytest.fixture
+def replica(tmp_path):
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    _save(tmp_path, 1, np.ones(64, np.float32))
+    server = ServingServer(str(tmp_path), model="hinge", port=0,
+                           host="127.0.0.1", ckpt_poll_s=30.0,
+                           metrics=Metrics()).start()
+    channel = new_channel("127.0.0.1", server.bound_port)
+    try:
+        yield server, ServeStub(channel)
+    finally:
+        channel.close()
+        server.stop()
+
+
+def test_pusher_sends_delta_when_acked_and_full_resend_on_gap(replica):
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    server, stub = replica
+    m = Metrics()
+    pusher = WeightPusher([("127.0.0.1", server.bound_port)], metrics=m)
+    w1 = np.ones(64, np.float32)
+    assert pusher.push(10, w1) == 1  # first contact: full form
+    assert m.counter(mm.SERVE_PUSH_FULL).value == 1
+    w2 = w1.copy()
+    w2[7] = 2.5
+    assert pusher.push(11, w2) == 1  # acked target + sparse change: delta
+    assert m.counter(mm.SERVE_PUSH_DELTA).value == 1
+    assert server.store.step == 11
+
+    # someone moved the replica out from under the pusher (restart stand-in)
+    stub.PushWeights(_push_full(99, w1), timeout=5)
+    w3 = w2.copy()
+    w3[9] = -1.0
+    assert pusher.push(12, w3) == 1  # delta NACKed, full resend same round
+    assert m.counter(mm.SERVE_PUSH_NACK).value >= 1
+    assert server.store.step == 12
+    np.testing.assert_array_equal(np.asarray(server.store.get()[1]), w3)
+    # wire accounting: the delta send was measurably below the full form
+    assert (m.counter(mm.SERVE_PUSH_BYTES).value
+            < m.counter(mm.SERVE_PUSH_FULL_EQUIV).value)
+    pusher.close()
+
+
+def test_checkpoint_distributor_streams_new_steps(tmp_path, replica):
+    from distributed_sgd_tpu.serving.push import CheckpointDistributor
+
+    server, _ = replica
+    ckpt_dir = tmp_path / "trainer-ckpt"
+    w1 = np.linspace(0, 1, 64).astype(np.float32)
+    _save(ckpt_dir, 1, w1)
+    m = Metrics()
+    dist = CheckpointDistributor(
+        str(ckpt_dir), [("127.0.0.1", server.bound_port)], poll_s=30.0,
+        metrics=m)
+    assert dist.poll_once()  # pushes the already-present step
+    assert server.store.step == 1 and server.store.push_mode
+    w2 = w1.copy()
+    w2[5] = 7.0
+    _save(ckpt_dir, 2, w2)
+    assert dist.poll_once()
+    assert not dist.poll_once()  # nothing new
+    assert server.store.step == 2
+    np.testing.assert_array_equal(np.asarray(server.store.get()[1]), w2)
+    assert m.counter(mm.SERVE_PUSH_DELTA).value == 1  # v2 rode the delta form
+    dist.stop()
+
+
+def test_load_probe_npz_strips_padding(tmp_path):
+    """The DSGD_SERVE_PROBE surface: padded 2-D npz -> stripped probe rows
+    (zero-VALUE cells are padding, the bucketing.py inert-pad convention)."""
+    from distributed_sgd_tpu.serving.router import load_probe, probe_from_dataset
+
+    path = tmp_path / "probe.npz"
+    np.savez(path,
+             indices=np.array([[3, 5, 0], [1, 0, 0]], np.int32),
+             values=np.array([[1.0, 2.0, 0.0], [4.0, 0.0, 0.0]], np.float32),
+             labels=np.array([1.0, -1.0], np.float32))
+    rows = load_probe(str(path))
+    assert len(rows) == 2
+    np.testing.assert_array_equal(rows[0][0], [3, 5])
+    np.testing.assert_array_equal(rows[0][1], [1.0, 2.0])
+    assert rows[0][2] == 1.0 and rows[1][2] == -1.0
+    np.testing.assert_array_equal(rows[1][0], [1])
+
+    # probe_from_dataset (the bench path) produces the same row shape
+    from distributed_sgd_tpu.data.rcv1 import Dataset
+
+    data = Dataset(indices=np.array([[3, 5, 0], [1, 0, 0]], np.int32),
+                   values=np.array([[1.0, 2.0, 0.0], [4.0, 0.0, 0.0]],
+                                   np.float32),
+                   labels=np.array([1, -1], np.int32), n_features=8)
+    ds_rows = probe_from_dataset(data, n=2)
+    np.testing.assert_array_equal(ds_rows[0][0], rows[0][0])
+    assert ds_rows[1][2] == -1.0
+
+
+# -- the router ---------------------------------------------------------------
+
+
+def test_router_p2c_picks_lower_score_and_skips_drained():
+    from distributed_sgd_tpu.serving.router import ServingRouter
+
+    router = ServingRouter([("127.0.0.1", 1), ("127.0.0.1", 2)], port=0,
+                           host="127.0.0.1", metrics=Metrics())
+    a, b = router._replicas
+    a.healthy = b.healthy = True
+    a.ewma_s, b.ewma_s = 0.001, 0.5
+    assert all(router._pick() is a for _ in range(16))
+    # in-flight load flips the choice
+    a.inflight = 10_000
+    assert router._pick() is b
+    # a drained replica leaves the eligible set entirely
+    a.inflight = 0
+    b.healthy = False
+    assert router._eligible() == [a]
+    # ... but the last-resort pool still answers when everyone is drained
+    a.healthy = False
+    assert router._pick() is not None
+    router.stop()
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=64).astype(np.float32)
+    _save(tmp_path, 1, w)
+    m = Metrics()
+    f = ServingFleet(str(tmp_path), n_replicas=3, ckpt_poll_s=30.0,
+                     health_s=0.2, hedge_ms=250.0, request_timeout_s=10.0,
+                     metrics=m).start()
+    channel = new_channel("127.0.0.1", f.router_port)
+    try:
+        yield f, ServeStub(channel), m, w
+    finally:
+        channel.close()
+        f.stop()
+
+
+def test_router_failover_zero_dropped_requests(fleet):
+    """Kill one replica under sustained concurrent load: every request is
+    still answered correctly (failover/hedging), and the health loop
+    drains the corpse."""
+    f, stub, m, w = fleet
+    errors, wrong = [], []
+    stop = threading.Event()
+
+    def client(k):
+        r = np.random.default_rng(k)
+        while not stop.is_set():
+            nnz = int(r.integers(1, 6))
+            idx = r.choice(64, size=nnz, replace=False).astype(np.int32)
+            val = r.normal(size=nnz).astype(np.float32)
+            try:
+                reply = stub.Predict(
+                    pb.PredictRequest(indices=idx, values=val), timeout=10)
+            except Exception as e:  # noqa: BLE001 - the assert below
+                errors.append(e)
+                continue
+            want = float((w[idx] * val).sum())
+            if abs(reply.margin - want) > 1e-4:
+                wrong.append((idx, reply.margin, want))
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)
+    f.kill_replica(0)  # mid-traffic crash
+    deadline = time.time() + 15
+    while (time.time() < deadline
+           and m.counter(mm.ROUTER_DRAINED).value == 0):
+        time.sleep(0.05)
+    time.sleep(0.5)  # keep load flowing on the 2-replica fleet
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, f"dropped requests: {errors[:3]}"
+    assert not wrong, wrong[:3]
+    assert m.counter(mm.ROUTER_DRAINED).value >= 1
+    health = stub.ServeHealth(pb.Empty(), timeout=5)
+    assert health.ok  # the fleet keeps serving on the survivors
+
+
+def _probe_rows(w, n=8):
+    """Single-coordinate probe rows labeled so `w` scores ZERO hinge loss
+    (y = predict(margin) = -sign(w[i])) — and any sign-flipped weights
+    score ~2.0: a crisp canary regression."""
+    rows = []
+    for i in range(n):
+        rows.append((np.array([i], np.int32), np.array([1.0], np.float32),
+                     float(-np.sign(w[i]) or 1.0)))
+    return rows
+
+
+def test_canary_rollback_and_promotion_e2e(tmp_path):
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+
+    rng = np.random.default_rng(3)
+    w_good = rng.normal(size=64).astype(np.float32)
+    w_good[w_good == 0] = 0.1
+    _save(tmp_path, 1, w_good)
+    m = Metrics()
+    probe = _probe_rows(w_good)
+    with ServingFleet(str(tmp_path), n_replicas=3, ckpt_poll_s=30.0,
+                      health_s=0.5, canary_fraction=0.34, probe=probe,
+                      metrics=m) as f:
+        router_targets = [("127.0.0.1", f.router_port)]
+        from distributed_sgd_tpu.serving.push import WeightPusher
+
+        pusher = WeightPusher(router_targets, metrics=Metrics())
+        # v2 promotes (same good weights, tiny benign change): baseline set
+        w2 = w_good.copy()
+        w2[0] *= 1.0 + 1e-3
+        assert pusher.push(2, w2) == 1
+        assert m.counter(mm.ROUTER_CANARY_PROMOTED).value >= 1
+        for r in f.replicas:
+            assert r.store.step == 2
+
+        # v3 is poisoned: probe loss jumps from ~0 to ~2 -> rollback
+        w_bad = -5.0 * w_good
+        assert pusher.push(3, w_bad) == 0  # NACKed by the canary gate
+        assert m.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1
+        # every replica still serves the promoted version — the canary
+        # was re-pinned, the rest never saw v3
+        for r in f.replicas:
+            assert r.store.step == 2
+            np.testing.assert_array_equal(np.asarray(r.store.get()[1]), w2)
+        # a re-push of the rejected version stays rejected
+        assert pusher.push(3, w_bad) == 0
+        assert m.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1  # no second canary
+
+        # the trainer recovers: v4 (good again) promotes fleet-wide
+        w4 = w_good.copy()
+        w4[1] *= 1.0 + 1e-3
+        assert pusher.push(4, w4) == 1
+        for r in f.replicas:
+            assert r.store.step == 4
+        # routed answers come from the promoted version
+        channel = new_channel("127.0.0.1", f.router_port)
+        reply = ServeStub(channel).Predict(
+            pb.PredictRequest(indices=[1], values=[1.0]), timeout=10)
+        assert reply.model_step == 4
+        channel.close()
+        pusher.close()
+
+
+def test_canary_survives_a_dead_first_replica(tmp_path):
+    """Canaries are drawn from the ELIGIBLE set: killing the replica that
+    static indexing would pick as THE canary must not freeze fleet
+    updates — the next pushed version still probes (on a live canary)
+    and promotes to the survivors."""
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    rng = np.random.default_rng(5)
+    w_good = rng.normal(size=64).astype(np.float32)
+    w_good[w_good == 0] = 0.1
+    _save(tmp_path, 1, w_good)
+    m = Metrics()
+    with ServingFleet(str(tmp_path), n_replicas=3, ckpt_poll_s=30.0,
+                      health_s=0.2, canary_fraction=0.34,
+                      probe=_probe_rows(w_good), metrics=m) as f:
+        pusher = WeightPusher([("127.0.0.1", f.router_port)],
+                              metrics=Metrics())
+        assert pusher.push(2, w_good) == 1  # baseline promoted
+        f.kill_replica(0)
+        deadline = time.time() + 15
+        while (time.time() < deadline
+               and m.counter(mm.ROUTER_DRAINED).value == 0):
+            time.sleep(0.05)
+        assert m.counter(mm.ROUTER_DRAINED).value >= 1
+        w3 = w_good.copy()
+        w3[2] *= 1.0 + 1e-3
+        assert pusher.push(3, w3) == 1  # still promotes past the corpse
+        assert m.counter(mm.ROUTER_CANARY_ROLLBACK).value == 0
+        for r in f.replicas[1:]:  # the survivors follow the stream
+            assert r.store.step == 3
+        pusher.close()
+
+
+def test_router_telemetry_endpoint_shows_per_replica_series(fleet):
+    import urllib.request
+
+    from distributed_sgd_tpu.telemetry.aggregate import (
+        ClusterExporter,
+        ClusterTelemetry,
+    )
+
+    f, stub, m, w = fleet
+    # a little traffic so the replica registries have series to merge
+    for i in range(4):
+        stub.Predict(pb.PredictRequest(indices=[i], values=[1.0]), timeout=10)
+    telemetry = ClusterTelemetry(m, node="route:test", role="route")
+    members = [(r.key, r.stub) for r in f.router._replicas]
+    got = telemetry.scrape(members, f.router._policy)
+    assert got == 3  # every replica answered the Metrics RPC
+    body = telemetry.prometheus_text()
+    # per-replica model-version gauges under their serve:<port> labels...
+    for r in f.replicas:
+        assert f'serve_model_version{{role="serve",worker="serve:{r.bound_port}"}}' in body
+    # ...and the latency histogram family merged across the fleet
+    assert 'serve_predict_duration_count{role="cluster"}' in body
+    # the ClusterExporter wrapper serves the same body over HTTP
+    exporter = ClusterExporter(telemetry.prometheus_text, 0, host="127.0.0.1")
+    exporter.start()
+    try:
+        url = f"http://127.0.0.1:{exporter.port}/metrics"
+        served = urllib.request.urlopen(url, timeout=5).read().decode()
+        assert "serve_model_version" in served
+    finally:
+        exporter.stop()
