@@ -366,6 +366,17 @@ def main() -> None:
 
         bench_hier.main(smoke="--smoke" in sys.argv)
         return
+    if "--spinup" in sys.argv:
+        # elastic spin-up gate (ISSUE 13): subprocess cold/warm A/B of a
+        # joining worker's time-to-first-contribution with the persistent
+        # compile cache + AOT warmup (>= 2x warm-vs-cold hard assert),
+        # spy-asserted O(delta) resplit re-loads through the row store,
+        # and the knobs-off byte-identity / zero-cache-files proof.
+        # --smoke is the CI-sized mode.
+        from benches import bench_spinup
+
+        bench_spinup.main(smoke="--smoke" in sys.argv)
+        return
     if "--serve" in sys.argv:
         # serving-fleet SLO gate (docs/SERVING.md "serving fleet"): the
         # closed loop — DevCluster trains while a 3-replica fleet serves,

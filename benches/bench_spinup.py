@@ -1,0 +1,316 @@
+"""Elastic spin-up gate (ISSUE 13): time-to-first-contribution, measured.
+
+Three claims, each hard-asserted every run (smoke and full):
+
+1. **Warm-cache join >= 2x faster than cold.**  A joining worker's
+   spin-up sequence — map the row store, load ONLY its host slice
+   through the store's RowReader, build the model + WorkerNode, run the
+   AOT warmup pass over its flagship shapes (grad capacity bucket + the
+   K-step local window), answer its first Gradient request — is run in a
+   FRESH subprocess per configuration (in-process A/B would share jax's
+   jit cache and measure nothing):
+
+   - ``knobsoff``: DSGD_COMPILE_CACHE unset — today's join (lazy JIT
+     under the first request, no warmup, no cache files);
+   - ``cold``: cache dir EMPTY — the first-ever join, which pays every
+     XLA compile and populates the shared cache;
+   - ``warm``: same cache dir, now populated — every later join; the
+     warmup's compiles are disk hits.
+
+   The clock starts after interpreter + jax import (identical in every
+   configuration; including it would only dilute the ratio) and stops
+   when the first gradient reply bytes exist.  Gate:
+   ``warm_spinup_s <= cold_spinup_s / 2``.
+
+2. **Resplit re-load reads the delta range only.**  An in-process
+   host-local worker (slice + RowReader over the same row store) is hit
+   with sample ids outside its resident slice — the elastic-resplit
+   signal — and the spy-counted rows its reload reads must equal EXACTLY
+   the uncovered delta range (+ the over-provision margin), vs the full
+   slice a naive reload would re-read.  ``resplit_reload_bytes`` gates
+   against history at the 10% bytes band (shape-determined, not timed).
+
+3. **Knobs-off byte-identical, zero files.**  The knobsoff child's first
+   gradient reply must be byte-identical (sha256) to the cold and warm
+   children's — the cache must never change math — and its would-be
+   cache directory must not exist afterwards.
+
+Timing fields use the ``*_spinup_s`` suffix: their own regression class
+in benches/regress.py (subprocess compile wall-clock on a shared host is
+noisier than a steady-state epoch, so the band is 50%, like the serve
+bench's tail quantiles).  Run: ``python bench.py --spinup [--smoke]``.
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_FEATURES = 47_236  # the flagship dim: compile cost is what we measure
+NNZ = 76
+BATCH = 100  # application.conf:15
+LOCAL_STEPS = 4  # the pipelined-engine flagship (bench_rpc_sync's K)
+MIN_SPEEDUP = 2.0  # the ISSUE bar: warm join >= 2x faster than cold
+# best-of-N children per configuration: one-shot subprocess wall clocks
+# jitter upward (page cache, scheduler), never downward — two reps keep
+# the >= 2x hard assert out of flake territory while staying inside the
+# tier-1 wall budget (each child is ~2-5 s of jax import + <1 s measured)
+FULL = dict(rows=16384, reps=3)
+SMOKE = dict(rows=4096, reps=2)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# child mode: one joining worker's spin-up, measured inside the process
+# ---------------------------------------------------------------------------
+
+def _child(spec: dict) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from distributed_sgd_tpu import compile_cache
+    from distributed_sgd_tpu.core.worker import WorkerNode
+    from distributed_sgd_tpu.data.host_shard import load_host_shard
+    from distributed_sgd_tpu.data.row_store import RowStore
+    from distributed_sgd_tpu.models.linear import make_model
+    from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+    cache_dir = spec["cache_dir"]
+    if cache_dir:
+        compile_cache.configure(cache_dir)
+    lo, hi = spec["slice"]
+    t0 = time.perf_counter()
+    # -- the joining worker's spin-up sequence (the measured region) -------
+    store = RowStore(spec["store"])
+    data = load_host_shard(store.reader, store.train_rows,
+                           store.n_features, store.pad_width, lo, hi)
+    model = make_model("hinge", 1e-5, store.n_features,
+                       dim_sparsity=store.dim_sparsity())
+    worker = WorkerNode(
+        "127.0.0.1", 0, "127.0.0.1", 1, data, model,
+        data_offset=lo, row_reader=store.reader,
+        total_rows=store.train_rows)
+    if cache_dir:
+        t = compile_cache.warmup_async(
+            "join", worker.warmup_thunks(BATCH, LOCAL_STEPS))
+        if t is not None:
+            t.join()  # join-to-steady-state: every flagship shape ready
+    ids = np.arange(lo, min(lo + BATCH, hi), dtype=np.int64)
+    g = worker.compute_gradient(np.zeros(store.n_features, np.float32), ids)
+    spinup_s = time.perf_counter() - t0
+    # ----------------------------------------------------------------------
+    m = metrics_mod.global_metrics()
+    print(json.dumps({
+        "spinup_s": spinup_s,
+        "rows_read": int(store.rows_read),
+        "bytes_read": int(store.bytes_read),
+        "grad_sha": hashlib.sha256(np.asarray(g).tobytes()).hexdigest(),
+        "cache_files": compile_cache.cache_file_count(),
+        "hits": m.counter(metrics_mod.COMPILE_CACHE_HITS).value,
+        "misses": m.counter(metrics_mod.COMPILE_CACHE_MISSES).value,
+        "warmed": m.counter(metrics_mod.COMPILE_WARMUP_KERNELS).value,
+    }))
+
+
+def _run_child(store: str, lo: int, hi: int, cache_dir) -> dict:
+    spec = {"store": store, "slice": [lo, hi], "cache_dir": cache_dir}
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # a would-be cache path the knobs-off child must NOT create
+    env.pop("DSGD_COMPILE_CACHE", None)
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child",
+         json.dumps(spec)],
+        capture_output=True, text=True, env=env, cwd=REPO, check=False)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"spin-up child failed:\n{out.stdout}\n{out.stderr[-4000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+# ---------------------------------------------------------------------------
+# parent: build the corpus + store once, A/B the joins, spy the resplit
+# ---------------------------------------------------------------------------
+
+def _build_store(tmp: str, rows: int) -> str:
+    from distributed_sgd_tpu.data.rcv1 import dim_sparsity
+    from distributed_sgd_tpu.data.row_store import build_row_store
+    from distributed_sgd_tpu.data.synthetic import rcv1_like
+
+    t0 = time.perf_counter()
+    data = rcv1_like(rows, n_features=N_FEATURES, nnz=NNZ, seed=0,
+                     idf_values=True)
+    path = os.path.join(tmp, "corpus.rows")
+    build_row_store(data, path, train_rows=rows,
+                    dim_sparsity=dim_sparsity(data))
+    log(f"row store built: {rows} rows, "
+        f"{os.path.getsize(path) / 1e6:.1f} MB in "
+        f"{time.perf_counter() - t0:.1f}s")
+    return path
+
+
+def _resplit_reload(store_path: str, rows: int, result: dict) -> None:
+    """Claim 2: the spy-asserted O(delta) reload, plus the zero-reload
+    over-provision fast path."""
+    import numpy as np
+
+    from distributed_sgd_tpu.core.worker import WorkerNode
+    from distributed_sgd_tpu.data.host_shard import overprovisioned_slice
+    from distributed_sgd_tpu.data.row_store import RowStore
+    from distributed_sgd_tpu.models.linear import make_model
+
+    store = RowStore(store_path)
+    n_hosts, f = 4, 0.1
+    lo, hi, s, e = overprovisioned_slice(rows, 1, n_hosts, overprovision=f)
+    data = store.read_rows(lo, hi)
+    model = make_model("hinge", 1e-5, store.n_features,
+                       dim_sparsity=store.dim_sparsity())
+    worker = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, data, model,
+                        data_offset=lo, row_reader=store.reader,
+                        total_rows=rows, host_overprovision=f)
+    w0 = np.zeros(store.n_features, np.float32)
+    slice_rows = hi - lo
+    stride = store.meta["row_stride_bytes"]
+
+    # (a) a resplit WITHIN the over-provision margin: zero reload
+    store.rows_read = store.bytes_read = 0
+    margin = s - lo  # rows of over-provisioned slack below the nominal start
+    shift = max(1, margin // 2)
+    worker.compute_gradient(w0, np.arange(s - shift, s - shift + BATCH))
+    assert store.rows_read == 0, (
+        f"in-margin resplit read {store.rows_read} rows; over-provision "
+        f"should have covered it")
+    # (b) a resplit PAST the margin: exactly the uncovered delta (+ its
+    # own margin), never the full slice
+    store.rows_read = store.bytes_read = 0
+    delta = BATCH
+    req_lo, req_hi = hi, min(rows, hi + delta)
+    worker.compute_gradient(w0, np.arange(req_lo, req_hi))
+    from distributed_sgd_tpu.data.host_shard import overprovision_margin
+
+    expect = min(rows, req_hi + overprovision_margin(req_hi - req_lo, f)) - hi
+    assert store.rows_read == expect, (
+        f"resplit reload read {store.rows_read} rows, expected the "
+        f"delta range {expect}")
+    log(f"resplit reload: {store.rows_read} rows "
+        f"({store.bytes_read} B) vs full slice {slice_rows} rows "
+        f"({slice_rows * stride} B)")
+    result.update({
+        "resplit_reload_bytes": store.bytes_read,
+        "resplit_full_reload_bytes_info": slice_rows * stride,
+        "resplit_reload_rows_info": store.rows_read,
+        "resplit_inmargin_rows_info": 0,
+    })
+
+
+def main(smoke: bool = False) -> None:
+    cfg = SMOKE if smoke else FULL
+    rows = cfg["rows"]
+    # distinct history series per mode (regress.py filters by "metric"):
+    # smoke and full run different corpus sizes, so sharing one series
+    # would gate each mode against the other's medians
+    result = {"metric": "spinup_smoke" if smoke else "spinup_full",
+              "rows": rows}
+    with tempfile.TemporaryDirectory(prefix="dsgd-spinup-") as tmp:
+        store = _build_store(tmp, rows)
+        # the join's host slice: host 1 of 4 (interior bounds exercise the
+        # clipping on both sides)
+        from distributed_sgd_tpu.data.host_shard import host_slice
+
+        lo, hi = host_slice(rows, 1, 4)
+        cache = os.path.join(tmp, "compile-cache")
+
+        # knobs-off FIRST: proves the path writes nothing even before any
+        # cache dir exists anywhere
+        off = _run_child(store, lo, hi, None)
+        assert not os.path.exists(cache), "knobs-off run created the cache dir"
+        assert off["cache_files"] == 0 and off["warmed"] == 0
+        log(f"knobsoff: {off['spinup_s']:.3f}s, {off['rows_read']} rows read")
+
+        colds, warms = [], []
+        for rep in range(cfg["reps"]):
+            # cold = empty dir (re-emptied per rep); warm = populated dir
+            for f in os.listdir(cache) if os.path.isdir(cache) else []:
+                os.remove(os.path.join(cache, f))
+            cold = _run_child(store, lo, hi, cache)
+            warm = _run_child(store, lo, hi, cache)
+            log(f"rep {rep}: cold {cold['spinup_s']:.3f}s "
+                f"(misses {cold['misses']}), warm {warm['spinup_s']:.3f}s "
+                f"(hits {warm['hits']}, misses {warm['misses']})")
+            colds.append(cold)
+            warms.append(warm)
+        cold = min(colds, key=lambda r: r["spinup_s"])
+        warm = min(warms, key=lambda r: r["spinup_s"])
+
+        # claim 3: byte-identical math, cache on or off
+        assert off["grad_sha"] == cold["grad_sha"] == warm["grad_sha"], (
+            "first gradient reply differs across cache configurations")
+        # the warm join actually HIT the cache, and the dir stopped growing
+        assert warm["hits"] > 0, "warm join recorded no persistent-cache hits"
+        assert warm["cache_files"] == cold["cache_files"], (
+            f"cache kept growing on the warm join: {cold['cache_files']} "
+            f"-> {warm['cache_files']} files")
+        # every join loaded ONLY its slice (+1 batch gather check margin)
+        assert off["rows_read"] == hi - lo
+
+        speedup = cold["spinup_s"] / max(warm["spinup_s"], 1e-9)
+        log(f"join time-to-first-contribution: cold {cold['spinup_s']:.3f}s "
+            f"-> warm {warm['spinup_s']:.3f}s ({speedup:.2f}x)")
+        assert speedup >= MIN_SPEEDUP, (
+            f"warm join only {speedup:.2f}x faster than cold "
+            f"(gate {MIN_SPEEDUP}x)")
+
+        result.update({
+            "cold_spinup_s": round(cold["spinup_s"], 4),
+            "warm_spinup_s": round(warm["spinup_s"], 4),
+            "knobsoff_spinup_s": round(off["spinup_s"], 4),
+            "spinup_speedup": round(speedup, 2),
+            "warm_cache_hits_info": warm["hits"],
+            "cold_cache_misses_info": cold["misses"],
+            "cache_files_info": warm["cache_files"],
+            "slice_rows_info": hi - lo,
+        })
+
+        _resplit_reload(store, rows, result)
+
+    # round-over-round recording (benches/regress.py): same policy as
+    # bench.py — a clean run is appended to history, a regressed one never
+    try:
+        from benches import regress
+
+        regressions, lines = regress.check(result, regress.load_history())
+        result["regressed"] = regressions
+        log(f"regression gate vs stored history:")
+        for ln in lines:
+            log(ln)
+        if regressions:
+            log(f"FAIL: regressed metrics: {', '.join(regressions)} "
+                f"(run NOT recorded)")
+        else:
+            regress.record(result)
+            log("PASS: run appended to benches/history.json")
+    except Exception as e:  # noqa: BLE001 - gating must not break the bench
+        log(f"regression gate skipped: {e}")
+        result["regressed"] = None
+        result["gate_error"] = str(e)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        _child(json.loads(sys.argv[2]))
+    else:
+        main(smoke="--smoke" in sys.argv)

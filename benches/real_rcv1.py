@@ -198,6 +198,43 @@ def parse_stage(folder: str, full_scale: bool) -> tuple:
                   "gate_pass": gate_pass, "gate_enforced": full_scale}
 
 
+def row_store_stage(folder: str, data) -> dict:
+    """Stage 2b: pack the parsed corpus into the mmap row store
+    (data/row_store.py) — the ONE parse every later worker spin-up
+    amortizes — and verify a host-slice read against the in-memory
+    arrays.  After this stage, `DSGD_ROW_STORE=<folder>/rcv1.rows` (+
+    `DSGD_HOST_INDEX=i`) gives the no-egress CLI worker role host-local
+    loading on the real corpus: map, read one slice, serve."""
+    import numpy as np
+
+    from distributed_sgd_tpu.data.host_shard import host_slice
+    from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+    from distributed_sgd_tpu.data.row_store import RowStore, build_row_store
+
+    path = os.path.join(folder, "rcv1.rows")
+    t0 = time.perf_counter()
+    train, _ = train_test_split(data)
+    meta = build_row_store(data, path, train_rows=len(train),
+                           dim_sparsity=dim_sparsity(train))
+    build_s = time.perf_counter() - t0
+    store = RowStore(path)
+    # spot-check: one host slice read back byte-identical
+    lo, hi = host_slice(store.train_rows, 0, 3)
+    hi = min(hi, lo + 1000)
+    back = store.read_rows(lo, hi)
+    assert np.array_equal(back.indices, data.indices[lo:hi])
+    assert np.array_equal(back.values, data.values[lo:hi])
+    assert np.array_equal(back.labels, data.labels[lo:hi])
+    log(f"row store built: {os.path.getsize(path) / 1e6:.1f} MB at "
+        f"{path} in {build_s:.1f}s (stride {meta['row_stride_bytes']} B; "
+        f"slice read of {hi - lo} rows verified)")
+    return {"path": path, "seconds": round(build_s, 2),
+            "bytes": os.path.getsize(path),
+            "row_stride_bytes": meta["row_stride_bytes"],
+            "train_rows": meta["train_rows"],
+            "verified_rows": hi - lo}
+
+
 def scenario_stage(data, max_epochs: int) -> dict:
     """Stage 3: the full application.conf-default scenario on parsed data."""
     from benches import full_scenario
@@ -274,6 +311,7 @@ def main(argv) -> int:
     out["files"] = ensure_files(folder, generated, rows)
     full_scale = not generated
     data, out["parse"] = parse_stage(folder, full_scale)
+    out["row_store"] = row_store_stage(folder, data)
     if slice_n is not None:
         data = slice_dataset(data, slice_n)
         out["slice"] = len(data)

@@ -1,0 +1,177 @@
+"""Persistent compile cache + AOT warmup: the elastic spin-up fast path.
+
+The reference's only join story is a registration retry loop
+(Slave.scala:40-77) — a joining worker pays full data load and, in this
+JAX reproduction, full XLA compilation before its first contribution.
+That makes elastic membership (docs/ELASTICITY.md) and autoscaling
+latency-bound on SPIN-UP rather than on steady-state math: the kernels a
+fresh worker compiles are byte-identical to the ones every previous
+worker already compiled.
+
+``DSGD_COMPILE_CACHE=<dir>`` turns that waste into a hit:
+
+- **persistent cache** — ``configure(dir)`` points jax's persistent
+  compilation cache at a shared directory (min-compile-time/min-size
+  floors dropped so every training/serving kernel is eligible).  XLA
+  backend compiles are keyed by the lowered HLO, so a joining worker, a
+  restarted master, or a fresh serve replica re-compiling a known
+  flagship shape reads the executable from disk instead of re-running
+  XLA.  jax's own monitoring events feed the
+  ``compile.cache.hits``/``compile.cache.misses`` counters
+  (utils/metrics.py), so the instruments cover every compile in the
+  process — not just the warmed ones.
+- **AOT warmup** — ``warmup_async(name, thunks)`` runs a role's flagship
+  compile thunks on ONE background daemon thread at bind/build time
+  (worker ``_grad_fn``/``_window_fn`` per capacity bucket and the hier
+  psum kernels via ``WorkerNode.warmup_thunks``, the mesh BoundSync epoch
+  program via ``BoundSync.warmup_thunks``, the serving per-bucket Predict
+  via ``PredictEngine.warmup_thunks``) so a joining node compiles while
+  it registers/loads instead of under its first request.  Worker/serving
+  thunks execute the real jitted callable once on inert zero inputs, so
+  they populate the IN-PROCESS dispatch cache too: the first real
+  dispatch after warmup performs no tracing at all
+  (tests/test_compile_cache.py proves it with a poisoned-trace spy).
+
+Knobs-off contract: with ``DSGD_COMPILE_CACHE`` unset nothing here runs —
+``configure`` is never called, jax's cache config keeps its defaults, no
+warmup thread starts, and no file is ever written (asserted by
+tests/test_compile_cache.py and ``bench.py --spinup``).
+
+Concurrency: a real dispatch arriving while its shape is still warming is
+safe — both threads call the same jitted callable and jax serializes /
+deduplicates the underlying executable; the race costs at most one
+redundant compile (which the persistent cache then absorbs), never a
+wrong result.  ``python bench.py --spinup`` gates the payoff: >= 2x
+faster time-to-first-contribution for a warm-cache join vs a cold one.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("dsgd.compile_cache")
+
+# one warmup thunk: (label, zero-arg callable that triggers the compile)
+WarmupThunk = Tuple[str, Callable[[], object]]
+
+_configured_dir: Optional[str] = None
+_listener_installed = False
+
+
+def configured_dir() -> Optional[str]:
+    """The active cache directory, or None when the knob is off."""
+    return _configured_dir
+
+
+def enabled() -> bool:
+    return _configured_dir is not None
+
+
+def configure(cache_dir: str, metrics=None) -> None:
+    """Enable jax's persistent compilation cache at `cache_dir` and start
+    counting its hits/misses.  Must run BEFORE the first jit dispatch of
+    the process (main.py calls it right after config load); idempotent.
+    """
+    global _configured_dir
+    import jax
+
+    from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # every kernel is spin-up-relevant: drop the "only cache slow/large
+    # compiles" floors so the per-capacity worker kernels (fast compiles
+    # individually, the whole set is what a join waits on) are eligible
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _configured_dir = cache_dir
+    _install_listener(metrics or metrics_mod.global_metrics())
+    log.info("persistent compile cache on: %s", cache_dir)
+
+
+def _install_listener(metrics) -> None:
+    """Feed jax's compilation-cache monitoring events into our counters.
+    Registered once per process; a jax without the private monitoring
+    surface just leaves the counters at zero (the cache still works)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax._src import monitoring
+    except Exception as e:  # noqa: BLE001 - instruments are best-effort
+        log.warning("compile-cache hit/miss counters unavailable (%s)", e)
+        return
+
+    from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+    hits = metrics.counter(metrics_mod.COMPILE_CACHE_HITS)
+    misses = metrics.counter(metrics_mod.COMPILE_CACHE_MISSES)
+
+    def _on_event(event: str, **kwargs) -> None:
+        if event.endswith("/cache_hits"):
+            hits.increment()
+        elif event.endswith("/cache_misses"):
+            misses.increment()
+
+    monitoring.register_event_listener(_on_event)
+    _listener_installed = True
+
+
+def run_warmup(name: str, thunks: Sequence[WarmupThunk],
+               metrics=None) -> int:
+    """Run `thunks` synchronously; returns how many compiled cleanly.
+
+    One failed thunk never kills the rest (or the caller): warmup is an
+    optimization, and the dispatch path compiles lazily exactly as it
+    would have without it — the failure is logged and counted."""
+    from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+    if metrics is None:
+        metrics = metrics_mod.global_metrics()
+    t0 = time.perf_counter()
+    done = 0
+    for label, thunk in thunks:
+        t1 = time.perf_counter()
+        try:
+            thunk()
+        except Exception as e:  # noqa: BLE001 - see docstring
+            metrics.counter(metrics_mod.COMPILE_WARMUP_ERRORS).increment()
+            log.warning("warmup %s/%s failed: %s", name, label, e)
+            continue
+        done += 1
+        metrics.counter(metrics_mod.COMPILE_WARMUP_KERNELS).increment()
+        log.info("warmed %s/%s in %.3fs", name, label,
+                 time.perf_counter() - t1)
+    metrics.gauge(metrics_mod.COMPILE_WARMUP_SECONDS).set(
+        time.perf_counter() - t0)
+    return done
+
+
+def warmup_async(name: str, thunks: Sequence[WarmupThunk],
+                 metrics=None) -> Optional[threading.Thread]:
+    """Start the AOT warmup pass for one role on a background daemon
+    thread (None when there is nothing to warm).  The caller keeps
+    spinning up — registration, data load, serving bind — while the
+    flagship shapes compile; join() the returned thread to run warmup
+    synchronously (the spin-up bench's warm path does, so its measured
+    first contribution is the pure post-warmup cost)."""
+    thunks = list(thunks)
+    if not thunks:
+        return None
+    t = threading.Thread(
+        target=run_warmup, args=(name, thunks, metrics),
+        daemon=True, name=f"warmup-{name}")
+    t.start()
+    return t
+
+
+def cache_file_count() -> int:
+    """Number of entries in the configured cache dir (0 when off/empty);
+    the cross-process reuse tests assert this stops growing on a rerun."""
+    import os
+
+    if _configured_dir is None or not os.path.isdir(_configured_dir):
+        return 0
+    return len(os.listdir(_configured_dir))

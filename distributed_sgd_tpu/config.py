@@ -181,6 +181,31 @@ class Config:
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
     feature_shards: int = 1
+    # -- elastic spin-up fast path (compile_cache.py, data/row_store.py;
+    # docs/HIERARCHY.md "Elastic composition") --------------------------
+    # persistent compile cache + AOT warmup: point jax's persistent
+    # compilation cache at a (shareable) directory and pre-compile each
+    # role's flagship shapes on a background thread at bind/build time,
+    # so a joining worker / restarted master / fresh serve replica never
+    # JITs under traffic.  None (default): jax's cache config untouched,
+    # no warmup thread, zero files written (asserted by test + bench).
+    compile_cache: Optional[str] = None
+    # neighbor-range over-provisioning for host-local slices: each
+    # worker loads ceil(f * slice) extra rows on both sides, so an
+    # elastic resplit within the margin costs ZERO reload and a bigger
+    # shift re-loads only the uncovered delta through its RowReader.
+    # 0 (default) keeps exact-slice loading byte-identical.
+    host_overprovision: float = 0.0
+    # mmap row store (data/row_store.py): path to a packed binary corpus
+    # built once from the parser (build_from_corpus).  A worker role with
+    # a store maps it instead of parsing, and with host_index loads ONLY
+    # its slice — the real-corpus no-egress host-local loading path.
+    row_store: Optional[str] = None
+    # this worker's position in the master's node_count-way contiguous
+    # split (worker role + row_store): load rows host_slice(train_rows,
+    # host_index, node_count) through the store's reader.  None = the
+    # full train split is resident (ids pass through untouched).
+    host_index: Optional[int] = None
     # hierarchical multi-host training (docs/HIERARCHY.md, engine=rpc):
     # each RPC worker becomes a D-device host — Gradient/local-window
     # batches shard over a local mesh and reduce with one in-host psum,
@@ -228,6 +253,12 @@ class Config:
     # on the next-best replica, first success wins (0 = no hedging)
     serve_hedge_ms: float = 0.0
     serve_health_s: float = 1.0  # router ServeHealth poll period
+    # promoted-state persistence (serving/router.py): a JSON sidecar the
+    # router rewrites on every promote/rollback, so a RESTARTED router
+    # re-pins the already-promoted serving version (and keeps its probe
+    # baseline + rejected set) instead of re-canarying it.  None
+    # (default): router state is in-memory only, byte-identical behavior.
+    serve_state: Optional[str] = None
 
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
@@ -308,6 +339,27 @@ class Config:
             raise ValueError(
                 "host_devices must be >= 0 (0 = auto from "
                 "jax.local_device_count(); 1 = flat single-device worker)")
+        # -- elastic spin-up fast path --------------------------------------
+        if not 0.0 <= self.host_overprovision <= 1.0:
+            raise ValueError(
+                "DSGD_HOST_OVERPROVISION must be a fraction in [0, 1] "
+                "(0 = exact slices; f loads ceil(f * slice) neighbor rows "
+                "on each side)")
+        if self.host_index is not None:
+            if not self.row_store:
+                raise ValueError(
+                    "DSGD_HOST_INDEX needs DSGD_ROW_STORE: a host-local "
+                    "slice is loaded through the store's row reader (the "
+                    "full-parse path always materializes the corpus)")
+            if not 0 <= self.host_index < self.node_count:
+                raise ValueError(
+                    f"DSGD_HOST_INDEX={self.host_index} outside "
+                    f"[0, node_count={self.node_count})")
+        if self.host_index is not None and self.host_devices not in (0, 1):
+            raise ValueError(
+                "DSGD_HOST_INDEX with DSGD_HOST_DEVICES > 1 is not "
+                "supported yet: the in-host mesh binds its slice at build "
+                "time (no incremental reload)")
         if self.feature_shards > 1 and self.use_async:
             raise ValueError(
                 "feature_shards is a sync (2-D mesh) engine; it cannot be "
@@ -463,6 +515,11 @@ class Config:
             delta_broadcast=_env("DSGD_DELTA_BROADCAST", cls.delta_broadcast, bool),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
             host_devices=_env("DSGD_HOST_DEVICES", cls.host_devices, int),
+            compile_cache=_env("DSGD_COMPILE_CACHE", None, str),
+            host_overprovision=_env("DSGD_HOST_OVERPROVISION",
+                                    cls.host_overprovision, float),
+            row_store=_env("DSGD_ROW_STORE", None, str),
+            host_index=_env("DSGD_HOST_INDEX", None, int),
             role_override=_env("DSGD_ROLE", None, str),
             serve_port=_env("DSGD_SERVE_PORT", cls.serve_port, int),
             serve_max_batch=_env("DSGD_SERVE_MAX_BATCH", cls.serve_max_batch, int),
@@ -476,6 +533,7 @@ class Config:
             serve_probe=_env("DSGD_SERVE_PROBE", None, str),
             serve_hedge_ms=_env("DSGD_SERVE_HEDGE_MS", cls.serve_hedge_ms, float),
             serve_health_s=_env("DSGD_SERVE_HEALTH_S", cls.serve_health_s, float),
+            serve_state=_env("DSGD_SERVE_STATE", None, str),
         )
         return dataclasses.replace(cfg, **overrides)
 

@@ -46,6 +46,7 @@ class DevCluster:
         telemetry_port: Optional[int] = None,
         host_devices: int = 1,
         host_local: bool = False,
+        host_overprovision: float = 0.0,
     ):
         """`host_devices > 1` builds a HIERARCHICAL cluster
         (docs/HIERARCHY.md): each worker is a multi-device host — a
@@ -55,8 +56,16 @@ class DevCluster:
         gives each worker ONLY its contiguous slice of the corpus
         (data/host_shard.py host_slice + WorkerNode data_offset), the
         no-host-materializes-the-corpus loading discipline; it requires
-        the master's default vanilla split (which DevCluster uses) and a
-        topology without mid-fit membership churn."""
+        the master's default vanilla split (which DevCluster uses).  With
+        the flat topology (host_devices=1) each host-local worker also
+        carries a RowReader over the corpus, so an elastic resplit
+        re-shards its slice INCREMENTALLY (O(delta) rows re-read) instead
+        of refusing the new sample ids; ``host_overprovision=f``
+        additionally widens each slice by ceil(f * slice) neighbor rows
+        per side so small boundary shifts cost zero reloads
+        (docs/HIERARCHY.md "Elastic composition").  Hierarchical workers
+        (host_devices > 1) keep the membership-stable contract: their
+        in-host mesh binds the slice at build time."""
         # fault injection (chaos/, DSGD_CHAOS): the plan must be installed
         # BEFORE any node opens a channel so every stub is wrapped — but it
         # stays un-armed through cluster formation (registration and peer
@@ -112,15 +121,27 @@ class DevCluster:
             from distributed_sgd_tpu.parallel.mesh import local_device_groups
 
             groups = local_device_groups(devs, n_workers, self._host_devices)
+        self._host_local = bool(host_local)
+        self._overprovision = max(0.0, float(host_overprovision))
         self.workers: List[WorkerNode] = []
         for i in range(n_workers):
             port = 0 if base_port == 0 else base_port + 1 + i
-            wdata, offset = train, None
+            wdata, offset, reader, total = train, None, None, None
             if host_local:
-                from distributed_sgd_tpu.data.host_shard import host_slice
+                from distributed_sgd_tpu.data.host_shard import (
+                    dataset_reader,
+                    overprovisioned_slice,
+                )
 
-                start, end = host_slice(len(train), i, n_workers)
-                wdata, offset = train.slice(slice(start, end)), start
+                lo, hi, _s, _e = overprovisioned_slice(
+                    len(train), i, n_workers,
+                    overprovision=self._overprovision)
+                wdata, offset = train.slice(slice(lo, hi)), lo
+                if self._host_devices == 1:
+                    # flat host-local workers can re-shard incrementally
+                    # (the reader is in-memory here — the discipline and
+                    # the O(delta) accounting are what dev mode proves)
+                    reader, total = dataset_reader(train), len(train)
             w = WorkerNode(
                 host, port, host, self.master.port, wdata, model,
                 device=devs[i % len(devs)], seed=seed + i,
@@ -134,6 +155,8 @@ class DevCluster:
                 host_devices=self._host_devices,
                 devices=groups[i] if groups is not None else None,
                 data_offset=offset,
+                row_reader=reader, total_rows=total,
+                host_overprovision=self._overprovision,
             )
             self.workers.append(w)
             if self._chaos_installed:
@@ -151,21 +174,39 @@ class DevCluster:
         log.info("dev cluster ready: master :%d + %d workers", self.master.port, n_workers)
 
     def add_worker(self, seed: Optional[int] = None,
-                   wait_registered: bool = True) -> WorkerNode:
+                   wait_registered: bool = True,
+                   host_local: Optional[bool] = None) -> WorkerNode:
         """Join a NEW worker to the running cluster (elastic churn /
         grow-back tests, docs/ELASTICITY.md): same data + model, an
         OS-assigned port, registered through the real control plane.  The
         master must have a free membership slot (an eviction or graceful
         leave frees one); an elastic fit absorbs the join at its next
-        membership tick."""
+        membership tick.
+
+        ``host_local`` (default: the cluster's setting) joins the worker
+        with an EMPTY resident slice and a RowReader: its first
+        assignment loads exactly its new slice (+ the over-provision
+        margin) through ``ensure_rows`` — the O(slice) spin-up path the
+        spin-up bench measures, instead of materializing the corpus."""
         i = len(self.workers)
+        host_local = (self._host_local and self._host_devices == 1
+                      if host_local is None else host_local)
+        wdata, extra = self._train, {}
+        if host_local:
+            from distributed_sgd_tpu.data.host_shard import dataset_reader
+
+            wdata = self._train.slice(slice(0, 0))
+            extra = dict(data_offset=0,
+                         row_reader=dataset_reader(self._train),
+                         total_rows=len(self._train),
+                         host_overprovision=self._overprovision)
         w = WorkerNode(
             self._host, 0, self._host, self.master.port,
-            self._train, self._model,
+            wdata, self._model,
             device=self._devs[i % len(self._devs)],
             seed=self._seed + i if seed is None else seed,
             metrics=self._node_metrics(),
-            **self._worker_kwargs,
+            **self._worker_kwargs, **extra,
         )
         self.workers.append(w)
         if self._chaos_installed:

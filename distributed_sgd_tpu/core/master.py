@@ -1320,6 +1320,12 @@ class MasterNode:
                     parts = self._split_parts(split, members)
                     max_samples = max(len(p) for p in parts)
                     bcast.forget_missing(keys)  # rejoins start from full
+                    # host-local workers absorb the new partition bounds
+                    # themselves: ids outside a resident slice trigger the
+                    # worker-side incremental reload (O(delta) rows through
+                    # its RowReader) or the classified foreign-id refusal
+                    self.metrics.counter(metrics_mod.SYNC_RESPLITS).increment()
+                    flight.record("sync.resplit", members=len(members))
                     self.log.warning("membership changed; re-split across %d workers",
                                      len(members))
                     if batch >= max_samples:

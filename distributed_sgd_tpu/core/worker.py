@@ -18,7 +18,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import grpc
 import jax
@@ -54,6 +54,24 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+class _Resident(NamedTuple):
+    """One consistent snapshot of the worker's resident data slice.
+
+    All five fields swap together (a single attribute assignment, atomic
+    under the GIL) when an elastic reload re-shards the slice
+    (``ensure_rows``), so a dispatch that grabbed the snapshot before the
+    swap computes entirely on the OLD slice with the OLD offset — never a
+    mix.  ``host`` keeps the host-side arrays only when a RowReader makes
+    incremental reloads possible (the overlap rows a reload reuses)."""
+
+    offset: Optional[int]  # global row id of local row 0 (None = full corpus)
+    n: int  # resident rows
+    idx: object  # device-resident indices / values / labels
+    val: object
+    y: object
+    host: Optional[Dataset]  # host copy for reload overlap reuse (reader set)
+
+
 class WorkerNode:
     def __init__(
         self,
@@ -81,6 +99,9 @@ class WorkerNode:
         host_devices: int = 1,
         devices=None,
         data_offset: Optional[int] = None,
+        row_reader=None,
+        total_rows: Optional[int] = None,
+        host_overprovision: float = 0.0,
     ):
         self.host, self.port = host, port
         self.log = node_logger(host, port, master=False)
@@ -167,6 +188,37 @@ class WorkerNode:
         # byte-identical to the pre-hierarchy engine.
         self._hier = None
         self.host_devices = max(1, int(host_devices))
+        # incremental host-local re-sharding (data/host_shard.py,
+        # docs/HIERARCHY.md "Elastic composition"): with a RowReader the
+        # worker can RELOAD its resident slice when an elastic resplit
+        # assigns rows outside it — reading only the uncovered delta —
+        # instead of refusing the foreign ids.  `host_overprovision`
+        # widens each reload by a neighbor-range margin so small boundary
+        # shifts cost zero reloads.  The reader's domain is the TRAIN
+        # corpus, so its row count must be explicit.
+        self._row_reader = row_reader
+        self._overprovision = max(0.0, float(host_overprovision))
+        self._total_rows = total_rows
+        self._reload_lock = threading.Lock()
+        # resident-extent budget for reloads (see ensure_rows): seeded by
+        # the constructed slice (nominal + over-provision), re-anchored by
+        # each full-assignment reload (start_async).  Bounds both memory
+        # and the per-reload device_put under drifting resplits.
+        self._resident_budget = len(data)
+        if row_reader is not None:
+            if total_rows is None:
+                raise ValueError(
+                    "row_reader needs total_rows: the reload path must "
+                    "know the reader's corpus extent to clip slices")
+            if data_offset is None:
+                raise ValueError(
+                    "row_reader without data_offset: a full-corpus worker "
+                    "has nothing to reload")
+            if host_devices > 1:
+                raise ValueError(
+                    "row_reader is incompatible with host_devices > 1: "
+                    "the in-host mesh replicates its slice at build time "
+                    "(elastic reload would need a mesh rebind)")
         if self.host_devices > 1:
             from distributed_sgd_tpu.parallel.hier import HostMeshEngine
 
@@ -180,21 +232,24 @@ class WorkerNode:
             # forward/async reuse the engine's mesh-replicated arrays
             # (ops on replicated arrays compute fine; the sync Gradient
             # plane is where the in-host reduction pays)
-            self._idx, self._val, self._y = (
+            res_idx, res_val, res_y = (
                 self._hier.idx, self._hier.val, self._hier.y)
         else:
             # device-resident copy of the worker's data (the reference
             # slave also holds the full data and receives sample indices,
             # Main.scala:138)
-            self._idx = jax.device_put(data.indices, self.device)
-            self._val = jax.device_put(data.values, self.device)
-            self._y = jax.device_put(data.labels, self.device)
-        self._n = len(data)
+            res_idx = jax.device_put(data.indices, self.device)
+            res_val = jax.device_put(data.values, self.device)
+            res_y = jax.device_put(data.labels, self.device)
         # host-local data slice (data/host_shard.py): `data` holds only
         # global rows [data_offset, data_offset + len(data)) and incoming
         # sample ids are mapped before any gather.  None (default) = the
-        # full corpus is resident and ids pass through untouched.
-        self._data_offset = data_offset
+        # full corpus is resident and ids pass through untouched.  The
+        # whole resident state lives in ONE snapshot tuple so an elastic
+        # reload swaps it atomically (see _Resident).
+        self._resident = _Resident(
+            data_offset, len(data), res_idx, res_val, res_y,
+            data if row_reader is not None else None)
         # which scatter formulation this node's kernels run, as a
         # scrapeable gauge (ROADMAP item: the DSGD_SCATTER=auto pick was
         # only logged; the cluster /metrics endpoint now attributes it —
@@ -257,6 +312,29 @@ class WorkerNode:
     def node_label(self) -> str:
         """Stable identity for trace spans and flight events."""
         return f"{self.host}:{self.port}"
+
+    # resident-slice views (read-only; the canonical state is the atomic
+    # _Resident snapshot — dispatch paths grab the snapshot ONCE and use
+    # its fields, these properties serve telemetry/tests)
+    @property
+    def _idx(self):
+        return self._resident.idx
+
+    @property
+    def _val(self):
+        return self._resident.val
+
+    @property
+    def _y(self):
+        return self._resident.y
+
+    @property
+    def _n(self) -> int:
+        return self._resident.n
+
+    @property
+    def _data_offset(self) -> Optional[int]:
+        return self._resident.offset
 
     # -- lifecycle (Slave.scala:40-77) -------------------------------------
 
@@ -441,25 +519,167 @@ class WorkerNode:
         valid[: len(ids)] = 1.0
         return jnp.asarray(padded), jnp.asarray(valid)
 
-    def _local_ids(self, ids: np.ndarray) -> np.ndarray:
-        """Map global sample ids into this worker's resident rows.
+    def warmup_thunks(self, batch_size: int, local_steps: int = 1):
+        """Flagship compile thunks for the AOT warmup pass
+        (compile_cache.py, DSGD_COMPILE_CACHE): the sync Gradient kernel
+        at this worker's configured capacity bucket, the K-step local
+        window when the pipelined engine is on, and their hierarchical
+        (in-host psum) twins on a multi-device host.  Each thunk runs the
+        REAL jitted callable once on inert inputs (zero weights, all-pad
+        batches — zero rows contribute zero gradient in every model), so
+        both the in-process dispatch cache and the persistent disk cache
+        are populated before the first master request arrives."""
+        d = self.model.n_features
+        bs = max(1, int(batch_size))
+        k = max(1, int(local_steps))
+        if self._resident.n == 0:
+            # an empty joining slice has no rows to gather from; kernels
+            # compile lazily after the first reload assigns real rows
+            return []
+        if self._hier is not None:
+            hier = self._hier
+            thunks = [(f"hier.grad[b{bs}]", lambda: hier.grad(
+                np.zeros(d, np.float32), np.zeros(bs, np.int64)))]
+            if k > 1:
+                thunks.append((f"hier.window[k{k},b{bs}]", lambda: (
+                    hier.local_window(np.zeros(d, np.float32),
+                                      np.zeros(k * bs, np.int64),
+                                      k, bs, 0.0))))
+            return thunks
+        cap = _next_pow2(bs)
+
+        def grad():
+            res = self._resident
+            np.asarray(self._grad_fn(cap)(
+                jnp.zeros(d, jnp.float32), res.idx, res.val, res.y,
+                jnp.zeros(cap, jnp.int32), jnp.zeros(cap, jnp.float32)))
+
+        thunks = [(f"grad[cap{cap}]", grad)]
+        if k > 1:
+
+            def window():
+                res = self._resident
+                np.asarray(self._window_fn(k, bs)(
+                    jnp.zeros(d, jnp.float32), res.idx, res.val, res.y,
+                    jnp.zeros((k, bs), jnp.int32),
+                    jnp.zeros((k, bs), jnp.float32), jnp.float32(0.0)))
+
+            thunks.append((f"window[k{k},b{bs}]", window))
+        return thunks
+
+    def _local_ids(self, ids: np.ndarray) -> Tuple[np.ndarray, "_Resident"]:
+        """Map global sample ids into this worker's resident rows; returns
+        (local ids, the resident snapshot they are valid against) — the
+        caller must compute on THAT snapshot's arrays, not re-read the
+        attributes (an elastic reload may swap them mid-dispatch).
 
         With the full corpus resident (data_offset=None, the default) ids
         pass through untouched — zero cost on the flat path.  A host-local
-        slice (data/host_shard.py) maps id -> id - offset and REFUSES ids
-        outside the slice: silently wrapping them would compute a gradient
-        over the wrong samples, and the failed RPC surfaces at the master
-        as a classified worker failure (retry/evict), which is the honest
-        signal that the split and the resident slices disagree."""
-        if self._data_offset is None:
-            return ids
-        local = np.asarray(ids, dtype=np.int64) - self._data_offset
-        if len(local) and (local.min() < 0 or local.max() >= self._n):
+        slice (data/host_shard.py) maps id -> id - offset; ids outside the
+        slice trigger an incremental RELOAD through the worker's RowReader
+        when one is configured (ensure_rows — the elastic resplit path,
+        O(delta) rows read), and are REFUSED otherwise: silently wrapping
+        them would compute a gradient over the wrong samples, and the
+        failed RPC surfaces at the master as a classified worker failure
+        (retry/evict), which is the honest signal that the split and the
+        resident slices disagree.  The refusal also covers the reload swap
+        window: a request racing the swap either maps cleanly against one
+        snapshot or fails loudly and is retried."""
+        res = self._resident
+        if res.offset is None:
+            return ids, res
+        local = np.asarray(ids, dtype=np.int64) - res.offset
+        if len(local) and (local.min() < 0 or local.max() >= res.n):
+            if self._row_reader is not None:
+                gmin = int(np.min(ids))
+                gmax = int(np.max(ids)) + 1
+                res = self.ensure_rows(gmin, gmax)
+                local = np.asarray(ids, dtype=np.int64) - res.offset
+                if not len(local) or (local.min() >= 0
+                                      and local.max() < res.n):
+                    return local, res
             raise ValueError(
                 f"sample ids outside this host's resident slice "
-                f"[{self._data_offset}, {self._data_offset + self._n}): "
+                f"[{res.offset}, {res.offset + res.n}): "
                 f"the master's split is not host-granular for this worker")
-        return local
+        return local, res
+
+    def ensure_rows(self, lo: int, hi: int) -> "_Resident":
+        """Grow/shift the resident slice to cover global rows [lo, hi)
+        through the RowReader, reading ONLY the uncovered delta
+        (data/host_shard.reload_slice) widened by the over-provision
+        margin (DSGD_HOST_OVERPROVISION); returns the current snapshot.
+
+        A range the slice already covers returns immediately (the
+        membership-stable fast path costs one tuple read + two compares).
+        An overlapping reload UNIONs with the resident range — repeated
+        window-level triggers after one resplit each read only their gap,
+        never re-read rows the previous trigger fetched — but the union
+        is BOUNDED by the resident budget (the constructed slice extent,
+        re-anchored by full-assignment reloads): when it would exceed the
+        budget, rows on the side FARTHEST from the requested range are
+        dropped, so drifting resplits slide a fixed-size window across
+        the corpus instead of growing the resident set monotonically
+        toward it (disk reads stay O(delta); host/device memory and the
+        per-reload device_put stay O(budget)).  A disjoint jump drops
+        the old rows entirely.  Swaps the _Resident snapshot atomically;
+        in-flight dispatches keep computing on the snapshot they
+        grabbed."""
+        from distributed_sgd_tpu.data import host_shard
+
+        with self._reload_lock:
+            res = self._resident
+            if (res.offset is None or self._row_reader is None
+                    or (lo >= res.offset and hi <= res.offset + res.n)):
+                return res
+            total = self._total_rows
+            margin = host_shard.overprovision_margin(
+                hi - lo, self._overprovision)
+            req_lo = max(0, lo - margin)
+            req_hi = min(total, max(hi, lo + 1) + margin)
+            want_lo, want_hi = req_lo, req_hi
+            if want_lo < res.offset + res.n and res.offset < want_hi:
+                # overlap: union so earlier rows stay warm
+                want_lo = min(want_lo, res.offset)
+                want_hi = max(want_hi, res.offset + res.n)
+            budget = max(self._resident_budget, req_hi - req_lo)
+            excess = (want_hi - want_lo) - budget
+            if excess > 0:
+                # trim old slack outside the requested range, biggest
+                # side first — the kept window always covers [req_lo,
+                # req_hi) and tracks the direction the split moved
+                slack_lo = req_lo - want_lo
+                slack_hi = want_hi - req_hi
+                if slack_lo >= slack_hi:
+                    cut = min(slack_lo, excess)
+                    want_lo += cut
+                    want_hi -= min(slack_hi, excess - cut)
+                else:
+                    cut = min(slack_hi, excess)
+                    want_hi -= cut
+                    want_lo += min(slack_lo, excess - cut)
+            host = res.host
+            new_data, rows_read = host_shard.reload_slice(
+                host, res.offset, self._row_reader, total,
+                host.n_features, host.pad_width if not host.is_dense else 0,
+                want_lo, want_hi, labels_dtype=host.labels.dtype)
+            new_res = _Resident(
+                want_lo, len(new_data),
+                jax.device_put(new_data.indices, self.device),
+                jax.device_put(new_data.values, self.device),
+                jax.device_put(new_data.labels, self.device),
+                new_data)
+            self._resident = new_res
+            self.metrics.counter(metrics_mod.DATA_RELOADS).increment()
+            self.metrics.counter(
+                metrics_mod.DATA_RELOAD_ROWS).increment(rows_read)
+            flight.record("data.reload", worker=self.node_label,
+                          start=want_lo, end=want_hi, rows_read=rows_read)
+            self.log.info(
+                "resident slice re-sharded: [%d, %d) -> [%d, %d), "
+                "%d row(s) read (delta only)", res.offset,
+                res.offset + res.n, want_lo, want_hi, rows_read)
+            return new_res
 
     def compute_gradient(self, w: np.ndarray, ids: np.ndarray) -> np.ndarray:
         """Sync Gradient RPC body: sum of backwards + regularize
@@ -467,14 +687,14 @@ class WorkerNode:
         over the local mesh and reduces with one in-host psum
         (parallel/hier.py) — same reply, one RPC per host."""
         self._profile.tick()
-        ids = self._local_ids(ids)
+        ids, res = self._local_ids(ids)
         if self._hier is not None:
             g = self._hier.grad(np.asarray(w, dtype=np.float32), ids)
             self.metrics.counter("slave.sync.backward").increment()
             return g
         pids, valid = self._pad_ids(ids)
         g = self._grad_fn(len(pids))(
-            jnp.asarray(w), self._idx, self._val, self._y, pids, valid
+            jnp.asarray(w), res.idx, res.val, res.y, pids, valid
         )
         self.metrics.counter("slave.sync.backward").increment()
         return np.asarray(g)
@@ -561,7 +781,7 @@ class WorkerNode:
         than k*batch_size ids — and is masked out via zeroed rows, so each
         (steps, batch_size) shape compiles exactly once."""
         self._profile.tick()
-        ids = self._local_ids(ids)
+        ids, res = self._local_ids(ids)
         bs = max(1, int(batch_size))
         n = len(ids)
         # step count derives from the ids actually sent, capped at k so an
@@ -580,7 +800,7 @@ class WorkerNode:
         valid = np.zeros(steps * bs, dtype=np.float32)
         valid[:n] = 1.0
         delta = self._window_fn(steps, bs)(
-            jnp.asarray(w), self._idx, self._val, self._y,
+            jnp.asarray(w), res.idx, res.val, res.y,
             jnp.asarray(padded.reshape(steps, bs)),
             jnp.asarray(valid.reshape(steps, bs)),
             jnp.float32(learning_rate),
@@ -685,10 +905,10 @@ class WorkerNode:
 
         Margins ride along so the master can compute margin-based losses
         (logistic) exactly — see ForwardReply in dsgd.proto."""
-        ids = self._local_ids(ids)
+        ids, res = self._local_ids(ids)
         pids, _ = self._pad_ids(ids)
         wj = jnp.asarray(w)
-        batch = SparseBatch(self._idx[pids], self._val[pids])
+        batch = SparseBatch(res.idx[pids], res.val[pids])
         margins = self.model.margins(wj, batch)
         preds = self.model.predict(margins)
         self.metrics.counter("slave.sync.forward").increment()
@@ -714,10 +934,27 @@ class WorkerNode:
                 "host_devices=%d: the async loop runs replicated on the "
                 "local mesh (the in-host psum accelerates the sync "
                 "Gradient plane)", self.host_devices)
-        if self._data_offset is not None:
-            assignment = np.asarray(assignment, dtype=np.int64) - self._data_offset
+        res = self._resident
+        if res.offset is not None:
+            if self._row_reader is not None and len(assignment):
+                # elastic resplit landing outside the resident slice:
+                # re-shard incrementally (O(delta) rows through the
+                # reader) BEFORE mapping, instead of refusing the fit.
+                # The assignment is the FULL new slice, so it re-anchors
+                # the resident budget (span + both margins) — later
+                # window-level reloads trim to this size
+                a_lo = int(np.min(assignment))
+                a_hi = int(np.max(assignment)) + 1
+                from distributed_sgd_tpu.data.host_shard import (
+                    overprovision_margin,
+                )
+
+                self._resident_budget = (a_hi - a_lo) + 2 * \
+                    overprovision_margin(a_hi - a_lo, self._overprovision)
+                res = self.ensure_rows(a_lo, a_hi)
+            assignment = np.asarray(assignment, dtype=np.int64) - res.offset
             if len(assignment) and (assignment.min() < 0
-                                    or assignment.max() >= self._n):
+                                    or assignment.max() >= res.n):
                 raise ValueError(
                     "StartAsync assignment outside this host's resident "
                     "slice (host-local loading needs a host-granular split)")
@@ -783,6 +1020,10 @@ class WorkerNode:
         n_assigned = int(self._assignment.shape[0])
         model = self.model
         ksteps = self.steps_per_dispatch
+        # one resident snapshot for the whole loop: the assignment was
+        # mapped against it in start_async, and a replacement StartAsync
+        # (the only path that re-shards mid-async) replaces this loop too
+        res = self._resident
 
         blocked = self._blocked_device()
         opt = self._async_opt
@@ -823,8 +1064,8 @@ class WorkerNode:
             self._profile.tick()
             snapshot = self._w  # stale read is the algorithm
             delta, opt_state = kstep(
-                snapshot, opt_state, self._assignment, self._idx, self._val,
-                self._y, k)
+                snapshot, opt_state, self._assignment, res.idx, res.val,
+                res.y, k)
             with self._w_lock:
                 self._w = self._apply(self._w, delta)
             self.metrics.counter("slave.async.batch").increment(ksteps)

@@ -2,12 +2,19 @@
 
 pybind11 is not available in this image, so the native parser exposes a C
 ABI (parser.cpp) loaded via ctypes.  The shared library is compiled with
-g++ on first use and cached next to the source, keyed by source mtime.
+g++ on first use and cached next to the source at a path KEYED by the
+toolchain fingerprint (compiler version + the arch `-march=native`
+resolves to on this machine + flags + ABI — see _lib_path), staleness
+checked by source mtime: machine classes sharing a volume each keep
+their own artifact, and a machine without g++ refuses to load a binary
+it cannot verify (the pure-python fallback takes over).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
+import json
 import logging
 import os
 import subprocess
@@ -20,12 +27,16 @@ log = logging.getLogger("dsgd.native")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "parser.cpp")
+# legacy unkeyed artifact path (pre-fingerprint builds); new builds land
+# at the toolchain-keyed path — see _lib_path
 _LIB = os.path.join(_DIR, "_libdsgd_parser.so")
 _LOCK = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 # must match parser.cpp dsgd_abi_version(): the CsrResult struct layout
 # (and any function signature) is pinned by this number
 _ABI_VERSION = 2
+_CXXFLAGS = ["-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+             "-pthread"]
 
 
 class _CsrResult(ctypes.Structure):
@@ -51,13 +62,73 @@ def _abi_version(lib: ctypes.CDLL) -> int:
     return int(fn())
 
 
-def _build() -> None:
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-        "-pthread", _SRC, "-o", _LIB,
-    ]
+def _toolchain_sig() -> Optional[dict]:
+    """Fingerprint of what a build HERE would produce: compiler version,
+    the arch `-march=native` actually resolves to on THIS machine, the
+    flag list, and the ABI pin.  None when g++ is unavailable.
+
+    The resolved march matters because the .so can outlive its build host
+    (a shared cache volume, a container image layered on a heterogeneous
+    fleet): `-march=native` on an AVX-512 builder emits instructions that
+    SIGILL on an older serving node, and neither the mtime check nor the
+    ABI export can see that — the ISA is invisible until the crash."""
+    try:
+        ver = subprocess.run(
+            ["g++", "--version"], check=True, capture_output=True,
+            text=True).stdout.splitlines()[0].strip()
+        target = subprocess.run(
+            ["g++", "-march=native", "-Q", "--help=target"], check=True,
+            capture_output=True, text=True).stdout
+    except (OSError, subprocess.CalledProcessError, IndexError):
+        return None
+    march = ""
+    for line in target.splitlines():
+        parts = line.split()
+        if len(parts) >= 2 and parts[0] in ("-march=", "-mtune="):
+            march += f"{parts[0]}{parts[1]} "
+    key = f"{ver}|{march.strip()}|{' '.join(_CXXFLAGS)}|abi={_ABI_VERSION}"
+    return {
+        "sig": hashlib.sha256(key.encode()).hexdigest(),
+        "compiler": ver,
+        "march": march.strip(),
+        "flags": _CXXFLAGS,
+        "abi": _ABI_VERSION,
+    }
+
+
+def _lib_path(sig: Optional[dict]) -> str:
+    """The build artifact is KEYED by the toolchain fingerprint: every
+    (compiler, resolved -march=native, flags, ABI) combination gets its
+    own `.so`, so a shared volume serving a heterogeneous fleet holds one
+    artifact per machine class — no cross-arch SIGILL, no rebuild
+    ping-pong where two arches endlessly overwrite one shared path.
+    Without a fingerprint (no g++) only the legacy unkeyed path could
+    exist, and load() refuses it as unverifiable."""
+    if sig is None:
+        return _LIB
+    return os.path.join(_DIR, f"_libdsgd_parser.{sig['sig'][:12]}.so")
+
+
+def _build(sig: Optional[dict]) -> str:
+    """Compile to the sig-keyed path via a pid-unique tmp + atomic
+    replace (concurrent same-arch builders each install a complete,
+    identical artifact) and record the fingerprint provenance sidecar;
+    returns the installed path."""
+    lib_path = _lib_path(sig)
+    tmp = f"{lib_path}.tmp.{os.getpid()}"
+    cmd = ["g++", *_CXXFLAGS, _SRC, "-o", tmp]
     log.info("building native parser: %s", " ".join(cmd))
-    subprocess.run(cmd, check=True, capture_output=True)
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, lib_path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    if sig is not None:
+        from distributed_sgd_tpu.utils.fsio import atomic_write_json
+
+        atomic_write_json(f"{lib_path}.build.json", sig)
+    return lib_path
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -67,16 +138,31 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None:
             return _lib
         try:
-            if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-                _build()
-            lib = ctypes.CDLL(_LIB)
+            # fingerprint THIS machine's toolchain (two g++ subprocesses,
+            # once per process at the first parse) and address the
+            # artifact it keys — see _lib_path
+            sig = _toolchain_sig()
+            if sig is None and os.path.exists(_LIB):
+                # no g++ to fingerprint with: a (possibly foreign
+                # -march=native) legacy .so would SIGILL uncatchably at
+                # the first parse, so refuse to load an UNVERIFIABLE
+                # binary — the raise lands in the except below and the
+                # pure-python parser takes over (slower, never fatal)
+                raise RuntimeError(
+                    "cached native parser cannot be verified on "
+                    "this machine (no g++ to resolve -march=native)")
+            lib_path = _lib_path(sig)
+            if (not os.path.exists(lib_path)
+                    or os.path.getmtime(lib_path) < os.path.getmtime(_SRC)):
+                lib_path = _build(sig)
+            lib = ctypes.CDLL(lib_path)
             if _abi_version(lib) != _ABI_VERSION:
                 # stale prebuilt .so whose mtime survived COPY/rsync/tar:
                 # an mtime check cannot see it, but reading the grown
                 # CsrResult through the old layout would be out-of-bounds
                 log.info("native parser ABI mismatch; rebuilding")
-                _build()
-                lib = ctypes.CDLL(_LIB)
+                lib_path = _build(sig)
+                lib = ctypes.CDLL(lib_path)
                 if _abi_version(lib) != _ABI_VERSION:
                     raise RuntimeError(
                         f"rebuilt native parser still reports ABI "

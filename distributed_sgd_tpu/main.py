@@ -325,7 +325,18 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                     gossip_topology=cfg.gossip_topology,
                     telemetry_port=cfg.telemetry_port if cfg.telemetry
                     else None,
-                    host_devices=host_devices) as c:
+                    host_devices=host_devices,
+                    host_overprovision=cfg.host_overprovision) as c:
+        if cfg.compile_cache:
+            # dev-mode spin-up fast path: every in-process worker warms
+            # its flagship shapes in the background before the fit's
+            # first fan-out reaches it
+            from distributed_sgd_tpu import compile_cache
+
+            for i, w in enumerate(c.workers):
+                compile_cache.warmup_async(
+                    f"worker[w{i}]",
+                    w.warmup_thunks(cfg.batch_size, cfg.local_steps))
         w0 = np.zeros(model.n_features, dtype=np.float32)
         loss0, acc0 = c.master.local_loss(w0, test=False)
         log.info("initial loss=%.6f acc=%.4f", loss0, acc0)
@@ -377,6 +388,16 @@ def main() -> None:
     log.info("host: %s (%s)", socket.gethostname(), sys.platform)
     log.info("config: %s", cfg.to_json())
     np.random.seed(cfg.seed)  # Main.scala:32 Random.setSeed(0)
+
+    # elastic spin-up fast path (compile_cache.py): point jax's persistent
+    # compilation cache at the shared directory BEFORE the first jit of
+    # the process, so every XLA compile below — warmup thunks and live
+    # traffic alike — reads/writes the cache.  Unset: nothing happens (no
+    # config touch, no files; asserted by tests/test_compile_cache.py).
+    if cfg.compile_cache:
+        from distributed_sgd_tpu import compile_cache
+
+        compile_cache.configure(cfg.compile_cache)
 
     # observability plumbing (docs/OBSERVABILITY.md), BEFORE any channel or
     # server exists so every RPC edge is covered:
@@ -506,6 +527,76 @@ def _serve_distributor(cfg: Config):
         metrics=metrics_mod.global_metrics()).start()
 
 
+def _build_worker_row_store(cfg: Config):
+    """DSGD_ROW_STORE on the worker role: map the packed corpus
+    (data/row_store.py) instead of parsing it, and with DSGD_HOST_INDEX
+    load ONLY this worker's host slice (+ the DSGD_HOST_OVERPROVISION
+    neighbor margin) through the store's RowReader — the no-egress
+    real-corpus host-local spin-up path (docs/HIERARCHY.md "Elastic
+    composition").  Returns (data, model, worker kwargs).
+
+    A missing store next to an existing corpus is built once (the one
+    parse every later spin-up amortizes); the train split's dim-sparsity
+    vector rides the store's sidecar so no worker re-scans the corpus to
+    build its model."""
+    from distributed_sgd_tpu.data import host_shard
+    from distributed_sgd_tpu.data.row_store import (
+        RowStore,
+        build_from_corpus,
+        meta_path,
+    )
+
+    if not os.path.exists(meta_path(cfg.row_store)):
+        log.info("row store %s missing: building from %s (one-time parse)",
+                 cfg.row_store, cfg.data_path)
+        measure.duration_log(
+            "row store built",
+            lambda: build_from_corpus(cfg.data_path, cfg.row_store,
+                                      full=cfg.full,
+                                      pad_width=cfg.pad_width), log)
+    store = RowStore(cfg.row_store)
+    ds = store.dim_sparsity()
+    if ds is None:
+        log.warning("row store has no dim-sparsity sidecar: the model "
+                    "falls back to the plain l2 regularizer")
+    model = make_model(cfg.model, cfg.lam, store.n_features,
+                       dim_sparsity=ds)
+    n_train = store.train_rows
+    if cfg.host_index is None:
+        # full train split resident, straight off the mmap — no parse,
+        # no reader needed (ids pass through untouched)
+        data = store.read_rows(0, n_train)
+        log.info("row store mapped: %d train rows resident (full split)",
+                 n_train)
+        return data, model, {}
+    lo, hi, start, end = host_shard.overprovisioned_slice(
+        n_train, cfg.host_index, cfg.node_count,
+        overprovision=cfg.host_overprovision)
+    data = host_shard.load_host_shard(
+        store.reader, n_train, store.n_features, store.pad_width,
+        lo, hi, labels_dtype=store.labels_dtype)
+    log.info(
+        "host-local slice %d/%d loaded through the row store: rows "
+        "[%d, %d) resident (nominal [%d, %d) + overprovision %g)",
+        cfg.host_index, cfg.node_count, lo, hi, start, end,
+        cfg.host_overprovision)
+    return data, model, dict(
+        data_offset=lo, row_reader=store.reader, total_rows=n_train,
+        host_overprovision=cfg.host_overprovision)
+
+
+def _warmup_worker(cfg: Config, worker) -> None:
+    """DSGD_COMPILE_CACHE on the worker role: kick the background AOT
+    pass over the worker's flagship shapes while registration runs."""
+    if not cfg.compile_cache:
+        return
+    from distributed_sgd_tpu import compile_cache
+
+    compile_cache.warmup_async(
+        f"worker[:{cfg.port}]",
+        worker.warmup_thunks(cfg.batch_size, cfg.local_steps))
+
+
 def _run_role(cfg: Config, role: str) -> None:
     if role == "route":
         # Serving-fleet router (serving/router.py; DSGD_ROLE=route): fans
@@ -522,6 +613,9 @@ def _run_role(cfg: Config, role: str) -> None:
             hedge_ms=cfg.serve_hedge_ms, health_s=cfg.serve_health_s,
             telemetry_port=cfg.telemetry_port if cfg.telemetry else None,
             metrics=metrics_mod.global_metrics(), seed=cfg.seed,
+            # DSGD_SERVE_STATE: a restarted router re-pins the promoted
+            # version instead of re-canarying it (docs/SERVING.md)
+            state_path=cfg.serve_state,
         ).start()
         log.info("routing on :%d over %s (canary=%g, hedge=%gms)",
                  router.bound_port, cfg.serve_targets, cfg.serve_canary,
@@ -548,6 +642,7 @@ def _run_role(cfg: Config, role: str) -> None:
             hedge_ms=cfg.serve_hedge_ms, health_s=cfg.serve_health_s,
             telemetry_port=cfg.telemetry_port if cfg.telemetry else None,
             metrics=metrics_mod.global_metrics(), seed=cfg.seed,
+            state_path=cfg.serve_state,
         ).start()
         log.info("serving fleet: router :%d over %d in-process replicas",
                  fleet.router_port, cfg.serve_replicas)
@@ -641,7 +736,20 @@ def _run_role(cfg: Config, role: str) -> None:
         from distributed_sgd_tpu.core.worker import WorkerNode
 
         _install_chaos(cfg)
-        train, _, model = build(cfg)
+        host_devices = _resolve_host_devices(cfg)
+        extra = {}
+        if cfg.row_store:
+            # mmap row store + optional host-local slice (spin-up fast
+            # path): no parse, and with DSGD_HOST_INDEX no full-corpus
+            # materialization either
+            if cfg.host_index is not None and host_devices > 1:
+                raise ValueError(
+                    "DSGD_HOST_INDEX with a multi-device in-host mesh is "
+                    "not supported (the mesh binds its slice at build "
+                    "time); set DSGD_HOST_DEVICES=1")
+            train, model, extra = _build_worker_row_store(cfg)
+        else:
+            train, _, model = build(cfg)
         _select_scatter(cfg, train)
         worker = WorkerNode(
             cfg.host, cfg.port, cfg.master_host, cfg.master_port, train, model,
@@ -663,8 +771,15 @@ def _run_role(cfg: Config, role: str) -> None:
             # becomes a D-device host — batches shard over the local
             # devices, gradients reduce with one in-host psum, and the
             # master's split turns host-granular via Node.devices
-            host_devices=_resolve_host_devices(cfg),
-        ).start()
+            host_devices=host_devices,
+            # host-local row-store slice (data_offset/row_reader/...)
+            **extra,
+        )
+        # AOT warmup races registration, not traffic: the flagship shapes
+        # compile (or disk-cache-hit) while the master is still
+        # introducing this worker to the membership
+        _warmup_worker(cfg, worker)
+        worker.start()
         worker.await_termination()
 
 

@@ -457,6 +457,40 @@ class BoundSync:
 
     # -- host API ----------------------------------------------------------
 
+    def warmup_thunks(self):
+        """Flagship compile thunks for the AOT warmup pass
+        (compile_cache.py, DSGD_COMPILE_CACHE): pre-lower + XLA-compile
+        the per-epoch training program and the eval program at this
+        binding's exact shapes WITHOUT executing them — ``lower(...)``
+        takes the real bound arrays (lowering reads shapes/shardings
+        only; donation consumes nothing until execution) and
+        ``.compile()`` populates the persistent cache, so the fit's first
+        dispatch re-traces cheaply and reads the XLA executable from
+        disk instead of re-running the backend compile."""
+        w0 = jnp.zeros((self.model.n_features,), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        d = self.data
+
+        def epoch():
+            self._epoch.lower(w0, self._opt_state, d.indices, d.values,
+                              d.labels, key).compile()
+
+        def evaluate():
+            self._eval.lower(w0, d.indices, d.values, d.labels).compile()
+
+        return [("epoch", epoch), ("eval", evaluate)]
+
+    def _maybe_warmup(self) -> None:
+        """Kick the background warmup at bind time when the compile cache
+        is configured (no-op — not even an import of jax state — when the
+        knob is off)."""
+        from distributed_sgd_tpu import compile_cache
+
+        if compile_cache.enabled():
+            compile_cache.warmup_async(
+                f"mesh[{self.n_workers}x{self.kernel}]",
+                self.warmup_thunks())
+
     def epoch(self, w: jax.Array, key: jax.Array) -> jax.Array:
         self._check_trainable()
         w, self._opt_state = self._epoch(
@@ -656,7 +690,7 @@ class SyncEngine:
             labels=put(local.labels),
             n_true=n_true,
         )
-        return BoundSync(
+        bound = BoundSync(
             self.model,
             self.mesh,
             sharded,
@@ -672,6 +706,11 @@ class SyncEngine:
             scatter=self.scatter,
             donate=self.donate,
         )
+        # spin-up fast path (compile_cache.py, DSGD_COMPILE_CACHE): start
+        # the background AOT pass at bind time, so the fit's first epoch
+        # finds its XLA executable in the persistent cache
+        bound._maybe_warmup()
+        return bound
 
     def bind_host_local(self, reader, n_samples: int, n_features: int,
                         pad_width: int,
@@ -696,7 +735,7 @@ class SyncEngine:
         sharded, chunk = host_local_sharded(
             self.mesh, reader, n_samples, n_features, pad_width,
             eval_chunk=self.eval_chunk, labels_dtype=labels_dtype)
-        return BoundSync(
+        bound = BoundSync(
             self.model,
             self.mesh,
             sharded,
@@ -712,6 +751,8 @@ class SyncEngine:
             scatter=self.scatter,
             donate=self.donate,
         )
+        bound._maybe_warmup()
+        return bound
 
 
 def padded_layout(n_true: int, n_workers: int, eval_chunk: int = 4096) -> Tuple[int, int]:

@@ -45,6 +45,7 @@ class ServingFleet:
         telemetry_port: Optional[int] = None,
         metrics=None,
         seed: int = 0,
+        state_path: Optional[str] = None,
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -65,6 +66,7 @@ class ServingFleet:
             probe=probe, hedge_ms=hedge_ms, health_s=health_s,
             request_timeout_s=request_timeout_s,
             telemetry_port=telemetry_port, metrics=metrics, seed=seed,
+            state_path=state_path,
         )
 
     @property

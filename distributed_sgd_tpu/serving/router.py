@@ -43,8 +43,10 @@ serving/fleet.py.
 
 from __future__ import annotations
 
+import json
 import logging
 import math
+import os
 import random
 import threading
 import time
@@ -179,6 +181,7 @@ class ServingRouter:
         metrics=None,
         telemetry_port: Optional[int] = None,
         seed: int = 0,
+        state_path: Optional[str] = None,
     ):
         if not replicas:
             raise ValueError("a router needs at least one replica endpoint")
@@ -213,6 +216,15 @@ class ServingRouter:
         from distributed_sgd_tpu.core.loss_check import LossChecker
 
         self._checker = LossChecker(leaky_loss=1.0)
+        # promoted-state persistence (ROADMAP 3b, DSGD_SERVE_STATE): a
+        # JSON sidecar rewritten atomically on every promote/rollback.  A
+        # RESTARTED router restores the promoted version, the probe-loss
+        # baseline, and the rejected set — so when the distributor
+        # re-streams the already-promoted version it RE-PINS it (ungated
+        # fan-out) instead of re-canarying it, and an already-rejected
+        # version stays rejected.  None (default): in-memory only.
+        self._state_path = state_path
+        self._restore_state()
 
         self._server = new_server(port, host=host)
         add_serve_servicer(self._server, self,
@@ -484,6 +496,62 @@ class ServingRouter:
             return False  # no baseline yet: first version promotes
         return loss > max(self.canary_ratio * best, best + self.CANARY_ABS_FLOOR)
 
+    # -- promoted-state persistence (ROADMAP 3b, DSGD_SERVE_STATE) ----------
+
+    def _restore_state(self) -> None:
+        """Load the promoted-state sidecar (no-op when unset/absent).  The
+        promoted WEIGHTS are not persisted — only their version and the
+        probe baseline — so the restored router NACKs deltas against the
+        unknown base (the pusher resends full, its normal gap path) and
+        re-pins the promoted version ungated when it arrives."""
+        if not self._state_path or not os.path.exists(self._state_path):
+            return
+        try:
+            with open(self._state_path) as f:
+                state = json.load(f)
+            # conversions INSIDE the guard: a sidecar that parses as JSON
+            # but carries garbage values (hand edit, foreign writer) must
+            # also land on the starting-fresh path, not crash startup
+            promoted = state.get("promoted_version")
+            promoted = None if promoted is None else int(promoted)
+            rejected = set(int(v) for v in state.get("rejected", []))
+            best = state.get("best_loss")
+            best = None if best is None else float(best)
+        except (OSError, ValueError, TypeError, AttributeError) as e:
+            log.warning("router state %s unreadable (%s); starting fresh",
+                        self._state_path, e)
+            return
+        if promoted is not None:
+            self._promoted_version = promoted
+        self._rejected = rejected
+        if best is not None:
+            # seed the LossChecker baseline without weights: best_loss is
+            # the only field the canary rule reads (leaky=1.0 checker)
+            self._checker.best_loss = best
+        log.info(
+            "router state restored from %s: promoted version %s, "
+            "baseline %s, %d rejected", self._state_path,
+            self._promoted_version, best, len(self._rejected))
+
+    def _persist_state(self) -> None:
+        """Atomically rewrite the sidecar (tmp + replace) after every
+        promote/rollback; called under _push_lock."""
+        if not self._state_path:
+            return
+        best = self._checker.best_loss
+        state = {
+            "promoted_version": self._promoted_version,
+            "best_loss": best if best != float("inf") else None,
+            "rejected": sorted(self._rejected),
+        }
+        try:
+            from distributed_sgd_tpu.utils.fsio import atomic_write_json
+
+            atomic_write_json(self._state_path, state)
+        except OSError as e:  # persistence must never fail a push
+            log.warning("router state write to %s failed: %s",
+                        self._state_path, e)
+
     def _promote(self, version: int, w: np.ndarray,
                  loss: Optional[float]) -> None:
         self._promoted_version = int(version)
@@ -492,27 +560,43 @@ class ServingRouter:
             self._checker.check(loss, 0.0, self._w_promoted, step=version)
             self.metrics.gauge(metrics_mod.ROUTER_CANARY_LOSS).set(loss)
         self.metrics.counter(metrics_mod.ROUTER_CANARY_PROMOTED).increment()
+        self._persist_state()
         log.info("version %d promoted fleet-wide (probe loss %s)",
                  version, f"{loss:.6f}" if loss is not None else "n/a")
 
-    def _repin(self, canaries: Sequence["_Replica"]) -> None:
+    def _repin(self, canaries: Sequence["_Replica"]) -> bool:
         """Re-install the promoted weights on the canary subset (a full
-        push — apply_push is authoritative at any version)."""
+        push — apply_push is authoritative at any version).  Returns
+        whether a re-pin was actually sent."""
+        if self._w_promoted is None:
+            # restored-state router that has not yet re-received the
+            # promoted weights: nothing to re-install — the canaries heal
+            # when the promoted version is re-streamed (re-pin path / gap
+            # fallback); callers must not claim a re-pin happened
+            log.warning("cannot re-pin canaries: promoted weights not in "
+                        "cache yet (restored state)")
+            return False
         req = pb.PushWeightsRequest(version=self._promoted_version)
         req.weights.CopyFrom(codec.encode_tensor(self._w_promoted))
         self._fan_out(req, canaries)
+        return True
 
     def _rollback(self, version: int, canaries: Sequence["_Replica"],
                   loss: float) -> None:
         self._rejected.add(int(version))
+        self._persist_state()
         self.metrics.counter(metrics_mod.ROUTER_CANARY_ROLLBACK).increment()
         flight.record("router.canary.rollback", version=int(version),
                       probe_loss=loss, baseline=self._checker.best_loss)
-        self._repin(canaries)
+        repinned = self._repin(canaries)
         log.warning(
-            "version %d ROLLED BACK (probe loss %.6f vs baseline %.6f): "
-            "canaries re-pinned to promoted version %d",
-            version, loss, self._checker.best_loss, self._promoted_version)
+            "version %d ROLLED BACK (probe loss %.6f vs baseline %.6f): %s",
+            version, loss, self._checker.best_loss,
+            f"canaries re-pinned to promoted version {self._promoted_version}"
+            if repinned else
+            f"canaries still serve the rejected weights until promoted "
+            f"version {self._promoted_version} is re-streamed (restored "
+            f"state has no weight cache)")
 
     def PushWeights(self, request, context):  # noqa: N802 - gRPC method name
         with self._push_lock:
@@ -535,6 +619,20 @@ class ServingRouter:
             # during one replica's outage as a NACK — full-form resends
             # of already-promoted versions, re-running the canary probe
             # and forfeiting the delta savings the feature exists for.
+            if (self._promoted_version is not None
+                    and version == self._promoted_version
+                    and self._w_promoted is None):
+                # the already-promoted version re-streamed after a router
+                # restart (DSGD_SERVE_STATE): RE-PIN it — ungated fan-out
+                # + refresh the promoted weight cache.  Re-canarying the
+                # version the fleet is already serving would burn a probe
+                # pass per restart and could roll back the live baseline
+                # on one noisy probe.
+                self._fan_out(request, self._replicas)
+                self._w_promoted = np.asarray(w_new, np.float32)
+                log.info("version %d re-pinned (already promoted before "
+                         "restart)", version)
+                return pb.PushWeightsReply(ok=True, model_step=version)
             n_canary = self._canary_count()
             gated = n_canary > 0 and self._promoted_version is not None
             if not gated:

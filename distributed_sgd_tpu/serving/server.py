@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
@@ -87,6 +88,43 @@ class PredictEngine:
             # predict() needs only the margin->label map, so no
             # dim_sparsity vector; lam is carried for parity but unused
             self._model = make_model(self._model_name, self._lam, n_features)
+
+    def warmup_thunks(self, n_features: int, max_batch: int):
+        """Flagship compile thunks for the AOT warmup pass
+        (compile_cache.py, DSGD_COMPILE_CACHE): the per-bucket Predict
+        programs a fresh replica would otherwise JIT under its first
+        traffic burst — the single-row bucket (isolated requests) and the
+        full `max_batch` flush bucket, at the minimum nnz width (further
+        widths compile lazily but hit the shared persistent cache when
+        any sibling replica saw them).  Each thunk runs the real jitted
+        forward once on zero rows, so the steady-state dispatch cache is
+        warm too."""
+        from distributed_sgd_tpu.serving.bucketing import (
+            MIN_BATCH_BUCKET,
+            MIN_NNZ_BUCKET,
+            bucket_shape,
+        )
+
+        self._ensure_model(int(n_features))
+        buckets = sorted({
+            bucket_shape(1, MIN_NNZ_BUCKET),
+            bucket_shape(max(MIN_BATCH_BUCKET, int(max_batch)),
+                         MIN_NNZ_BUCKET),
+        })
+        w = jnp.zeros((int(n_features),), jnp.float32)
+
+        def thunk(b, p):
+            def run():
+                np.asarray(self._jit(w, jnp.zeros((b, p), jnp.int32),
+                                     jnp.zeros((b, p), jnp.float32))[0])
+                # only a SUCCESSFUL warm counts as compiled — a failed
+                # thunk must leave run()'s serve.jit.compile accounting
+                # intact for the real traffic that will pay the JIT
+                self._compiled_buckets.add((b, p))
+
+            return run
+
+        return [(f"predict[B{b},P{p}]", thunk(b, p)) for b, p in buckets]
 
     def run(
         self, snapshot: Optional[Tuple[int, jnp.ndarray]],
@@ -282,13 +320,44 @@ class ServingServer:
         self.store.start()
         self.batcher.start()
         self._server.start()
+        self._maybe_warmup()
         log.info("serving on :%d (model step %s)", self.bound_port, self.store.step)
         return self
+
+    def _maybe_warmup(self) -> None:
+        """Spin-up fast path (compile_cache.py, DSGD_COMPILE_CACHE): warm
+        the per-bucket Predict programs on a background thread as soon as
+        the first checkpoint snapshot lands (the model dimension is not
+        known before it), so a fresh replica never JITs under its first
+        traffic burst.  No-op when the knob is off."""
+        from distributed_sgd_tpu import compile_cache
+
+        if not compile_cache.enabled():
+            return
+        self._warm_stop = threading.Event()
+
+        def _wait_and_warm():
+            while not self._warm_stop.is_set():
+                snapshot = self.store.get()
+                if snapshot is not None:
+                    _step, w = snapshot
+                    compile_cache.run_warmup(
+                        f"serve[:{self.bound_port}]",
+                        self.engine.warmup_thunks(int(w.shape[0]),
+                                                  self.batcher.max_batch),
+                        metrics=self.metrics)
+                    return
+                self._warm_stop.wait(0.2)
+
+        threading.Thread(target=_wait_and_warm, daemon=True,
+                         name="serve-warmup").start()
 
     def await_termination(self) -> None:
         self._server.wait_for_termination()
 
     def stop(self, grace: float = 1.0) -> None:
+        if getattr(self, "_warm_stop", None) is not None:
+            self._warm_stop.set()
         self._server.stop(grace).wait()
         self.batcher.stop()
         self.store.stop()

@@ -1,0 +1,32 @@
+"""Crash/concurrency-safe small-file writes, shared across sidecars.
+
+One copy of the pid-unique-tmp + fsync + atomic-replace idiom (the
+checkpointer's discipline, checkpoint.py:228) for every JSON sidecar that
+several processes may write or read concurrently — the row-store offsets
+sidecar, the native parser's build-provenance record, the router's
+promoted-state file.  A reader sees the old complete file or the new
+complete file, never a torn one; concurrent writers each install a
+complete file, last writer wins.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """Serialize `obj` to `path` atomically (pid-unique tmp + fsync +
+    os.replace).  Raises on I/O failure — callers for whom persistence is
+    best-effort catch at their level."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=1, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)

@@ -474,6 +474,26 @@ def record_push(metrics: "Metrics", form: str, wire_bytes: int,
     metrics.counter(f"serve.push.{form}").increment()
 
 
+# -- elastic spin-up fast path (compile_cache.py, data/host_shard.py;
+# docs/HIERARCHY.md "Elastic composition") ------------------------------------
+# The compile plane (DSGD_COMPILE_CACHE): persistent-cache hit/miss counts
+# come from jax's own monitoring events, so they cover EVERY XLA compile in
+# the process — warmup thunks and live traffic alike; warmup.* attribute
+# what the background AOT pass did before the first dispatch needed it.
+COMPILE_CACHE_HITS = "compile.cache.hits"        # counter: XLA compiles served from disk
+COMPILE_CACHE_MISSES = "compile.cache.misses"    # counter: XLA compiles paid in full
+COMPILE_WARMUP_KERNELS = "compile.warmup.kernels"  # counter: flagship shapes pre-compiled
+COMPILE_WARMUP_SECONDS = "compile.warmup.seconds"  # gauge: background warmup wall clock
+COMPILE_WARMUP_ERRORS = "compile.warmup.errors"  # counter: thunks that failed (logged)
+# The data plane (DSGD_HOST_OVERPROVISION + RowReader reload): an elastic
+# resplit that lands outside the worker's resident slice re-loads ONLY the
+# delta row range through its reader — reload.rows is the O(delta) proof
+# the spin-up bench gates against a full slice reload.
+DATA_RELOADS = "slave.data.reloads"              # counter: resident-slice reloads
+DATA_RELOAD_ROWS = "slave.data.reload.rows"      # counter: rows read for reloads
+SYNC_RESPLITS = "master.sync.resplit"            # counter: mid-fit membership resplits
+
+
 # which sparse-scatter formulation the process's kernels run (DSGD_SCATTER,
 # ops/mxu.py; ROADMAP item 2 follow-up): gauge value indexes
 # mxu.SCATTER_FORMULATIONS ('onehot'=0, 'segment'=1, 'twostage'=2,

@@ -1,0 +1,176 @@
+"""Compile-cache semantics (compile_cache.py, DSGD_COMPILE_CACHE).
+
+The contracts under test (ISSUE 13 satellites):
+
+- knobs-off writes ZERO files and the math stays byte-identical with the
+  cache on or off (subprocess A/B — in-process runs would share jax's jit
+  cache and prove nothing);
+- the warmup pass populates the real dispatch cache: the first dispatch
+  after warmup performs no tracing at all (poisoned-trace spy), and a
+  dispatch racing the warmup thread is safe;
+- cache-dir reuse across two processes actually HITS: the second process
+  records persistent-cache hits and the file count stops growing.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu import compile_cache
+from distributed_sgd_tpu.core.worker import WorkerNode
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one tiny spin-up: build a worker, (optionally) configure + warm, answer
+# one gradient.  argv[1] is the cache dir or "-" for knobs-off.
+_CHILD = """
+import hashlib, json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distributed_sgd_tpu import compile_cache
+from distributed_sgd_tpu.core.worker import WorkerNode
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.utils import metrics as mm
+
+cache = None if sys.argv[1] == "-" else sys.argv[1]
+if cache:
+    compile_cache.configure(cache)
+data = rcv1_like(64, n_features=256, nnz=4, seed=0)
+model = make_model("hinge", 1e-5, 256)
+w = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, data, model)
+if cache:
+    t = compile_cache.warmup_async("child", w.warmup_thunks(8, 2))
+    t.join()
+g = w.compute_gradient(np.zeros(256, np.float32), np.arange(8))
+m = mm.global_metrics()
+print(json.dumps({
+    "sha": hashlib.sha256(np.asarray(g).tobytes()).hexdigest(),
+    "files": compile_cache.cache_file_count(),
+    "hits": m.counter(mm.COMPILE_CACHE_HITS).value,
+    "misses": m.counter(mm.COMPILE_CACHE_MISSES).value,
+    "warmed": m.counter(mm.COMPILE_WARMUP_KERNELS).value,
+}))
+"""
+
+
+def _spinup_child(cache_arg: str) -> dict:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DSGD_COMPILE_CACHE", None)
+    out = subprocess.run(
+        [sys.executable, "-c", _CHILD, cache_arg],
+        capture_output=True, text=True, env=env, cwd=REPO, check=False)
+    assert out.returncode == 0, out.stderr[-4000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@pytest.fixture(scope="module")
+def spinup_runs(tmp_path_factory):
+    """(knobsoff, cold, warm) children sharing one cache dir — run once
+    per module (each child pays a jax import)."""
+    tmp = tmp_path_factory.mktemp("compile-cache")
+    cache = str(tmp / "cc")
+    off = _spinup_child("-")
+    assert not os.path.exists(cache)
+    cold = _spinup_child(cache)
+    warm = _spinup_child(cache)
+    return {"cache": cache, "off": off, "cold": cold, "warm": warm}
+
+
+def test_knobs_off_writes_zero_files_and_is_byte_identical(spinup_runs):
+    off, cold, warm = (spinup_runs[k] for k in ("off", "cold", "warm"))
+    # knobs-off: no cache dir, no files, no warmup thread, no hit/miss
+    # events (the listener is only installed by configure())
+    assert off["files"] == 0
+    assert off["warmed"] == 0
+    assert off["hits"] == 0 and off["misses"] == 0
+    # and the cache never changes the math: same reply bytes in all three
+    assert off["sha"] == cold["sha"] == warm["sha"]
+
+
+def test_cache_dir_reuse_across_processes_hits(spinup_runs):
+    cold, warm = spinup_runs["cold"], spinup_runs["warm"]
+    # the first (cold) process compiled for real and populated the dir
+    assert cold["misses"] > 0
+    assert cold["files"] > 0
+    assert cold["warmed"] == 2  # grad + window thunks
+    # the second process READ those entries: hits recorded, zero fresh
+    # compiles of the warmed shapes, and the file count stopped growing
+    assert warm["hits"] > 0
+    assert warm["misses"] == 0
+    assert warm["files"] == cold["files"]
+
+
+def _mini_worker(seed=0):
+    data = rcv1_like(64, n_features=128, nnz=4, seed=seed)
+    model = make_model("hinge", 1e-5, 128)
+    return WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, data, model), model
+
+
+def test_warmup_leaves_first_dispatch_nothing_to_trace():
+    """Poisoned-trace spy: after the warmup thread joins, the first real
+    Gradient/window dispatch must be a pure dispatch-cache hit — jax only
+    calls the traced python body (which reads model.grad_regularized) on
+    a RE-trace, so poisoning the model after warmup proves there is
+    none."""
+    worker, model = _mini_worker()
+    t = compile_cache.warmup_async("test", worker.warmup_thunks(8, 2))
+    assert t is not None
+    t.join(timeout=60)
+    assert not t.is_alive()
+
+    def boom(*a, **k):  # noqa: ANN001 - spy
+        raise AssertionError("first dispatch re-traced after warmup")
+
+    model.grad_regularized = boom
+    w0 = np.zeros(128, np.float32)
+    g = worker.compute_gradient(w0, np.arange(8))  # capacity bucket 8
+    assert np.isfinite(g).all()
+    d = worker.compute_local_window(w0, np.arange(16), 2, 8, 0.1)
+    assert np.isfinite(d).all()
+
+
+def test_warmup_racing_first_dispatch_is_safe():
+    """A dispatch arriving while its shape is still warming must return
+    the correct gradient (jax serializes/deduplicates the underlying
+    compile; worst case is one redundant compile, never a wrong
+    result)."""
+    worker, _ = _mini_worker(seed=1)
+    reference, _ = _mini_worker(seed=1)
+    w0 = np.zeros(128, np.float32)
+    t = compile_cache.warmup_async("race", worker.warmup_thunks(8, 2))
+    g = worker.compute_gradient(w0, np.arange(8))  # races the warmup
+    t.join(timeout=60)
+    np.testing.assert_array_equal(g, reference.compute_gradient(
+        w0, np.arange(8)))
+
+
+def test_empty_slice_worker_has_no_thunks():
+    """A joining host-local worker with an EMPTY resident slice (rows
+    arrive with its first assignment) must not warm kernels over a
+    zero-row gather."""
+    from distributed_sgd_tpu.data.host_shard import dataset_reader
+
+    data = rcv1_like(64, n_features=128, nnz=4, seed=0)
+    model = make_model("hinge", 1e-5, 128)
+    w = WorkerNode("127.0.0.1", 0, "127.0.0.1", 1,
+                   data.slice(slice(0, 0)), model, data_offset=0,
+                   row_reader=dataset_reader(data), total_rows=64)
+    assert w.warmup_thunks(8, 2) == []
+    assert compile_cache.warmup_async("empty", w.warmup_thunks(8, 2)) is None
+
+
+def test_knob_is_off_in_this_process():
+    """Tier-1 runs with the knob unset: nothing in the suite may have
+    configured the process-global cache (it would silently change every
+    other test's compile path)."""
+    assert not compile_cache.enabled()
+    assert compile_cache.configured_dir() is None
+    assert compile_cache.cache_file_count() == 0

@@ -15,6 +15,9 @@ from distributed_sgd_tpu.data.host_shard import (
     dataset_reader,
     host_slice,
     load_host_shard,
+    overprovision_margin,
+    overprovisioned_slice,
+    reload_slice,
 )
 from distributed_sgd_tpu.data.synthetic import dense_regression, rcv1_like
 from distributed_sgd_tpu.parallel.multihost import host_shard_bounds
@@ -142,6 +145,195 @@ def test_loader_refuses_bad_reader_shapes():
                         0, 10)
     with pytest.raises(ValueError, match="bad shard bounds"):
         load_host_shard(dataset_reader(full), 20, 32, full.pad_width, 5, 3)
+
+
+# -- elastic composition: over-provisioning + incremental re-sharding -------
+# (ISSUE 13 / docs/HIERARCHY.md "Elastic composition")
+
+
+def test_overprovisioned_slice_bounds_and_clipping():
+    # f=0 is byte-identical to host_slice (the knobs-off contract)
+    for i in range(4):
+        lo, hi, s, e = overprovisioned_slice(103, i, 4, overprovision=0.0)
+        assert (lo, hi) == (s, e) == host_slice(103, i, 4)
+    # interior host: ceil(f * span) rows of neighbor range on each side
+    lo, hi, s, e = overprovisioned_slice(400, 1, 4, overprovision=0.1)
+    assert (s, e) == host_slice(400, 1, 4)
+    m = overprovision_margin(e - s, 0.1)
+    assert m == 10
+    assert (lo, hi) == (s - m, e + m)
+    # edge hosts clip to the corpus
+    lo0, hi0, s0, e0 = overprovisioned_slice(400, 0, 4, overprovision=0.1)
+    assert lo0 == 0 and hi0 == e0 + 10
+    lo3, hi3, s3, e3 = overprovisioned_slice(400, 3, 4, overprovision=0.1)
+    assert hi3 == 400 and lo3 == s3 - 10
+    # a whole-corpus margin clips cleanly too
+    lo, hi, _s, _e = overprovisioned_slice(40, 0, 2, overprovision=1.0)
+    assert (lo, hi) == (0, 40)
+
+
+class _SpyStore:
+    """Reader wrapper counting rows per call (the O(delta) proof)."""
+
+    def __init__(self, data):
+        self.data = data
+        self.calls = []
+
+    def __call__(self, start, stop):
+        self.calls.append((start, stop))
+        return self.data.slice(slice(start, stop))
+
+    @property
+    def rows_read(self):
+        return sum(b - a for a, b in self.calls)
+
+
+def test_reload_slice_reads_only_the_delta():
+    full = rcv1_like(200, n_features=32, nnz=3, seed=2)
+    cur = full.slice(slice(40, 100))
+    spy = _SpyStore(full)
+    # grow right: only [100, 130) is read
+    new, rows = reload_slice(cur, 40, spy, 200, 32, full.pad_width, 40, 130)
+    assert rows == 30 and spy.calls == [(100, 130)]
+    assert np.array_equal(new.indices, full.indices[40:130])
+    assert np.array_equal(new.labels, full.labels[40:130])
+    # shift left+right around an overlap: two clipped gap reads
+    spy = _SpyStore(full)
+    new, rows = reload_slice(cur, 40, spy, 200, 32, full.pad_width, 20, 120)
+    assert rows == 40 and spy.calls == [(20, 40), (100, 120)]
+    assert np.array_equal(new.values, full.values[20:120])
+    # disjoint jump: the whole new range is one gap
+    spy = _SpyStore(full)
+    new, rows = reload_slice(cur, 40, spy, 200, 32, full.pad_width, 150, 180)
+    assert rows == 30 and spy.calls == [(150, 180)]
+    assert np.array_equal(new.labels, full.labels[150:180])
+
+
+def test_reload_slice_pads_past_the_corpus():
+    full = rcv1_like(50, n_features=16, nnz=2, seed=0)
+    cur = full.slice(slice(20, 40))
+    spy = _SpyStore(full)
+    # the new range runs past n_samples: reads clip to the real rows,
+    # the rest is inert padding (zeros, label 0)
+    new, rows = reload_slice(cur, 20, spy, 50, 16, full.pad_width, 30, 60)
+    assert rows == 10 and spy.calls == [(40, 50)]
+    assert len(new) == 30
+    assert np.array_equal(new.labels[:20], full.labels[30:50])
+    assert not new.values[20:].any() and not new.labels[20:].any()
+
+
+def _worker(data, model, **kw):
+    from distributed_sgd_tpu.core.worker import WorkerNode
+
+    # master endpoint is never dialed: these tests exercise the compute
+    # surface only
+    return WorkerNode("127.0.0.1", 0, "127.0.0.1", 1, data, model, **kw)
+
+
+def test_worker_resplit_reloads_delta_and_matches_full_worker():
+    """The elastic-resplit path end to end at the worker: sample ids
+    outside the resident slice trigger ONE incremental reload (delta rows
+    + the over-provision margin through the reader), and the gradient
+    afterwards is byte-identical to a full-corpus worker's."""
+    from distributed_sgd_tpu.models.linear import make_model
+    from distributed_sgd_tpu.utils import metrics as mm
+
+    full = rcv1_like(400, n_features=64, nnz=4, seed=0)
+    model = make_model("hinge", 1e-5, 64)
+    lo, hi, s, e = overprovisioned_slice(400, 1, 4, overprovision=0.1)
+    spy = _SpyStore(full)
+    w = _worker(full.slice(slice(lo, hi)), model, data_offset=lo,
+                row_reader=spy, total_rows=400, host_overprovision=0.1)
+    w0 = np.zeros(64, np.float32)
+    # in-slice (including the over-provisioned margin): zero reloads
+    w.compute_gradient(w0, np.arange(lo, lo + 32))
+    assert spy.calls == []
+    # a resplit shifted past the slice: one reload, delta + margin only
+    reloads0 = mm.counter(mm.DATA_RELOADS).value
+    g = w.compute_gradient(w0, np.arange(hi, hi + 32))
+    assert len(spy.calls) == 1
+    (a, b), = spy.calls
+    assert a == hi  # nothing resident is ever re-read
+    assert b - a <= 32 + overprovision_margin(32, 0.1)
+    assert mm.counter(mm.DATA_RELOADS).value == reloads0 + 1
+    wf = _worker(full, model)
+    np.testing.assert_array_equal(
+        g, wf.compute_gradient(w0, np.arange(hi, hi + 32)))
+    # without a reader the refusal contract is unchanged
+    w2 = _worker(full.slice(slice(s, e)), model, data_offset=s)
+    with pytest.raises(ValueError, match="resident slice"):
+        w2.compute_gradient(w0, np.arange(e, e + 8))
+
+
+def test_worker_drifting_resplits_keep_a_bounded_resident_window():
+    """Repeated one-directional resplits must SLIDE a budget-bounded
+    window across the corpus — union-without-bound would grow the
+    resident slice monotonically toward the full corpus, defeating the
+    host-local discipline on a long-running elastic fit."""
+    from distributed_sgd_tpu.models.linear import make_model
+
+    full = rcv1_like(2000, n_features=32, nnz=3, seed=1)
+    model = make_model("hinge", 1e-5, 32)
+    spy = _SpyStore(full)
+    w = _worker(full.slice(slice(0, 200)), model, data_offset=0,
+                row_reader=spy, total_rows=2000, host_overprovision=0.0)
+    w0 = np.zeros(32, np.float32)
+    budget = 200
+    for step in range(1, 9):  # keep shifting the slice right by 100
+        lo = step * 100
+        w.compute_gradient(w0, np.arange(lo + 100, lo + 200))
+        res = w._resident
+        assert res.n <= budget + 100  # bounded, never the whole corpus
+        # the requested rows are always resident after the reload
+        assert res.offset <= lo + 100 and res.offset + res.n >= lo + 200
+    # every row was read at most ~once: O(delta) disk reads held across
+    # the whole drift (no thrash from the trimming either)
+    assert spy.rows_read <= 900
+
+
+def test_worker_reader_requires_offset_and_total():
+    from distributed_sgd_tpu.models.linear import make_model
+
+    full = rcv1_like(40, n_features=16, nnz=2, seed=0)
+    model = make_model("hinge", 1e-5, 16)
+    with pytest.raises(ValueError, match="total_rows"):
+        _worker(full.slice(slice(0, 10)), model, data_offset=0,
+                row_reader=dataset_reader(full))
+    with pytest.raises(ValueError, match="data_offset"):
+        _worker(full, model, row_reader=dataset_reader(full),
+                total_rows=40)
+
+
+def test_host_local_cluster_resplits_incrementally_across_fits():
+    """DevCluster e2e: host-local workers with readers survive a
+    membership change — the next fit's wider slices arrive by O(delta)
+    reloads, not refusals/evictions, and training completes."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.core.early_stopping import no_improvement
+    from distributed_sgd_tpu.data.rcv1 import train_test_split
+    from distributed_sgd_tpu.models.linear import make_model
+    from distributed_sgd_tpu.utils import metrics as mm
+
+    data = rcv1_like(600, n_features=64, nnz=4, seed=0, idf_values=True)
+    train, test = train_test_split(data)
+    model = make_model("hinge", 1e-4, 64)
+    reloads0 = mm.counter(mm.DATA_RELOADS).value
+    with DevCluster(model, train, test, n_workers=3, seed=0,
+                    host_local=True, host_overprovision=0.1) as c:
+        crit = no_improvement(patience=3, min_delta=0.0)
+        res1 = c.master.fit_sync(2, 32, 0.5, crit)
+        assert np.isfinite(res1.state.loss)
+        assert mm.counter(mm.DATA_RELOADS).value == reloads0  # stable fit
+        # graceful leave -> the next fit splits over 2 workers: each
+        # survivor's slice grows and the delta loads through its reader
+        c.workers.pop(2).stop()
+        res2 = c.master.fit_sync(2, 32, 0.5,
+                                 no_improvement(patience=3, min_delta=0.0))
+        assert np.isfinite(res2.state.loss)
+        assert mm.counter(mm.DATA_RELOADS).value > reloads0
+        # the reloads absorbed the resplit: both survivors still members
+        # (a refusal would have classified them as failed -> evicted)
+        assert len(c.master._members()) == 2
 
 
 def test_host_slice_matches_the_master_split():

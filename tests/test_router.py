@@ -508,6 +508,86 @@ def test_canary_rollback_and_promotion_e2e(tmp_path):
         pusher.close()
 
 
+def test_promoted_state_persists_and_restarted_router_repins(tmp_path):
+    """ROADMAP 3b (small half): with DSGD_SERVE_STATE the router persists
+    promoted version + LossChecker baseline + rejected set to a JSON
+    sidecar — a restarted router RE-PINS the already-promoted version on
+    its next push (no canary probe burned), keeps rejected versions
+    rejected, and gates NEW versions against the restored baseline."""
+    import json
+    import os
+
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    rng = np.random.default_rng(7)
+    w_good = rng.normal(size=64).astype(np.float32)
+    w_good[w_good == 0] = 0.1
+    _save(tmp_path / "ckpt", 1, w_good)
+    state = str(tmp_path / "router-state.json")
+    probe = _probe_rows(w_good)
+
+    m1 = Metrics()
+    with ServingFleet(str(tmp_path / "ckpt"), n_replicas=2,
+                      ckpt_poll_s=30.0, health_s=0.5, canary_fraction=0.5,
+                      probe=probe, metrics=m1, state_path=state) as f:
+        pusher = WeightPusher([("127.0.0.1", f.router_port)],
+                              metrics=Metrics())
+        assert pusher.push(2, w_good) == 1  # promoted, baseline recorded
+        w_bad = -5.0 * w_good
+        assert pusher.push(3, w_bad) == 0  # rolled back, rejection recorded
+        pusher.close()
+    persisted = json.load(open(state))
+    assert persisted["promoted_version"] == 2
+    assert persisted["rejected"] == [3]
+    assert persisted["best_loss"] is not None
+
+    # "restart": a fresh fleet restoring the same sidecar
+    m2 = Metrics()
+    with ServingFleet(str(tmp_path / "ckpt"), n_replicas=2,
+                      ckpt_poll_s=30.0, health_s=0.5, canary_fraction=0.5,
+                      probe=probe, metrics=m2, state_path=state) as f:
+        pusher = WeightPusher([("127.0.0.1", f.router_port)],
+                              metrics=Metrics())
+        # the distributor re-streams the promoted version: RE-PINNED, not
+        # re-canaried — no probe pass, no promotion counter
+        assert pusher.push(2, w_good) == 1
+        assert m2.counter(mm.ROUTER_CANARY_PROMOTED).value == 0
+        for r in f.replicas:
+            np.testing.assert_array_equal(
+                np.asarray(r.store.get()[1]), w_good)
+        # a rejected version STAYS rejected across the restart (and burns
+        # no second canary probe)
+        assert pusher.push(3, w_bad) == 0
+        assert m2.counter(mm.ROUTER_CANARY_ROLLBACK).value == 0
+        # new versions flow through the restored canary gate normally
+        w4 = w_good.copy()
+        w4[3] *= 1.0 + 1e-3
+        assert pusher.push(4, w4) == 1
+        assert m2.counter(mm.ROUTER_CANARY_PROMOTED).value == 1
+        assert json.load(open(state))["promoted_version"] == 4
+        # and a genuinely poisoned one still rolls back against the
+        # RESTORED baseline (the checker survived the restart)
+        assert pusher.push(5, w_bad) == 0
+        assert m2.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1
+        pusher.close()
+    assert os.path.exists(state)
+
+
+def test_malformed_state_sidecar_starts_fresh(tmp_path):
+    """A state file that parses as JSON but carries garbage values (hand
+    edit, foreign writer) must start the router fresh — never crash the
+    route role at startup."""
+    from distributed_sgd_tpu.serving.router import ServingRouter
+
+    state = tmp_path / "state.json"
+    state.write_text('{"promoted_version": "two", "rejected": ["x"]}')
+    r = ServingRouter([("127.0.0.1", 1)], metrics=Metrics(),
+                      state_path=str(state))
+    assert r._promoted_version is None and r._rejected == set()
+    r.stop(grace=0.1)
+
+
 def test_canary_survives_a_dead_first_replica(tmp_path):
     """Canaries are drawn from the ELIGIBLE set: killing the replica that
     static indexing would pick as THE canary must not freeze fleet

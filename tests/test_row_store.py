@@ -1,0 +1,141 @@
+"""mmap row store (data/row_store.py): the file-backed RowReader.
+
+Contracts: build/read roundtrip is byte-identical to the source arrays
+(sparse and dense layouts); ``read_rows`` is one contiguous record slice
+with exact byte accounting; the store plugs into the host-shard loader
+as a RowReader; ``build_from_corpus`` runs the real parser once and the
+sidecars (offsets meta, train cut, dim-sparsity) make a worker spin-up
+self-contained."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.data.host_shard import dataset_reader, load_host_shard
+from distributed_sgd_tpu.data.row_store import (
+    RowStore,
+    build_from_corpus,
+    build_row_store,
+)
+from distributed_sgd_tpu.data.synthetic import dense_regression, rcv1_like
+
+
+def test_sparse_roundtrip_and_byte_accounting(tmp_path):
+    data = rcv1_like(300, n_features=64, nnz=5, seed=0)
+    path = str(tmp_path / "rows.bin")
+    meta = build_row_store(data, path, train_rows=240)
+    assert os.path.exists(path + ".meta.json")
+    st = RowStore(path)
+    assert len(st) == 300 and st.train_rows == 240
+    assert st.n_features == 64 and st.pad_width == data.pad_width
+    back = st.read_rows(37, 141)
+    assert np.array_equal(back.indices, data.indices[37:141])
+    assert np.array_equal(back.values, data.values[37:141])
+    assert np.array_equal(back.labels, data.labels[37:141])
+    # one contiguous record slice: exactly (stop-start) * stride bytes
+    assert st.calls == 1
+    assert st.rows_read == 141 - 37
+    assert st.bytes_read == (141 - 37) * meta["row_stride_bytes"]
+    # the sidecar documents the offset arithmetic
+    assert meta["payload_offset"] + 300 * meta["row_stride_bytes"] \
+        == os.path.getsize(path)
+
+
+def test_dense_layout_roundtrip(tmp_path):
+    data = dense_regression(40, n_features=16, seed=0)
+    path = str(tmp_path / "dense.bin")
+    build_row_store(data, path)
+    st = RowStore(path)
+    assert st.pad_width == 0
+    back = st.read_rows(5, 25)
+    assert back.is_dense
+    assert np.array_equal(back.values, data.values[5:25])
+    assert back.labels.dtype == np.float32
+    np.testing.assert_array_equal(back.labels, data.labels[5:25])
+
+
+def test_store_is_a_row_reader_for_the_host_shard_loader(tmp_path):
+    data = rcv1_like(100, n_features=32, nnz=3, seed=1)
+    path = str(tmp_path / "rows.bin")
+    build_row_store(data, path)
+    st = RowStore(path)
+    shard = load_host_shard(st.reader, 100, 32, data.pad_width, 60, 120)
+    ref = load_host_shard(dataset_reader(data), 100, 32, data.pad_width,
+                          60, 120)
+    assert np.array_equal(shard.indices, ref.indices)
+    assert np.array_equal(shard.values, ref.values)
+    assert np.array_equal(shard.labels, ref.labels)
+    assert st.rows_read == 40  # the clipped real extent only
+
+
+def test_bounds_and_corruption_are_refused(tmp_path):
+    data = rcv1_like(20, n_features=16, nnz=2, seed=0)
+    path = str(tmp_path / "rows.bin")
+    build_row_store(data, path)
+    st = RowStore(path)
+    with pytest.raises(ValueError, match="row range"):
+        st.read_rows(5, 25)
+    with pytest.raises(FileNotFoundError, match="sidecar missing"):
+        RowStore(str(tmp_path / "nope.bin"))
+    # a truncated payload must fail at open, not at a mid-fit read
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 8)
+    with pytest.raises(ValueError, match="truncated"):
+        RowStore(path)
+    # a doctored stride (sidecar/payload layout drift) is refused too
+    mp = path + ".meta.json"
+    meta = json.load(open(mp))
+    meta["row_stride_bytes"] += 4
+    json.dump(meta, open(mp, "w"))
+    with pytest.raises(ValueError, match="layout mismatch"):
+        RowStore(path)
+
+
+def test_build_from_corpus_parses_once_and_records_sidecars(tmp_path):
+    """The real-corpus path: write a mini corpus in the reference's text
+    format, build the store through the actual parser, and verify the
+    packed rows + the train cut + the dim-sparsity sidecar against a
+    direct load_rcv1."""
+    from distributed_sgd_tpu.data.corpus import write_rcv1_corpus
+    from distributed_sgd_tpu.data.rcv1 import (
+        dim_sparsity,
+        load_rcv1,
+        train_test_split,
+    )
+
+    folder = str(tmp_path / "corpus")
+    write_rcv1_corpus(folder, n_rows=240, n_train=60, n_template=64,
+                      n_features=512, seed=3)
+    path = str(tmp_path / "rcv1.rows")
+    meta = build_from_corpus(folder, path, full=True)
+    ref = load_rcv1(folder, full=True)
+    train, _ = train_test_split(ref)
+    st = RowStore(path)
+    assert len(st) == len(ref)
+    assert st.train_rows == len(train) == meta["train_rows"]
+    back = st.read_rows(0, len(ref))
+    assert np.array_equal(back.indices, ref.indices)
+    assert np.array_equal(back.values, ref.values)
+    assert np.array_equal(back.labels, ref.labels)
+    ds = st.dim_sparsity()
+    assert ds is not None
+    np.testing.assert_allclose(ds, dim_sparsity(train), rtol=0, atol=0)
+
+
+def test_config_validation_for_the_worker_role():
+    from distributed_sgd_tpu.config import Config
+
+    # host_index needs the store, and must sit inside the split
+    with pytest.raises(ValueError, match="DSGD_HOST_INDEX needs"):
+        Config(host_index=0)
+    with pytest.raises(ValueError, match="outside"):
+        Config(row_store="x", host_index=7, node_count=3)
+    with pytest.raises(ValueError, match="OVERPROVISION"):
+        Config(host_overprovision=1.5)
+    # the in-host mesh binds its slice at build time: no reload path
+    with pytest.raises(ValueError, match="HOST_DEVICES"):
+        Config(row_store="x", host_index=0, host_devices=2)
+    c = Config(row_store="x", host_index=2, host_overprovision=0.25)
+    assert c.host_index == 2
