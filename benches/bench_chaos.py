@@ -18,11 +18,17 @@ partition of w1 — with a fixed seed so every run injects the same faults:
   with stall accounting enabled lands on bit-identical weights to the
   plain knobs-off run (asserted in --smoke).
 
-Four runs, one fresh cluster each, counters diffed from the global
+Five runs, one fresh cluster each, counters diffed from the global
 registry: ``baseline`` (no chaos, knobs off), ``baseline_observed`` (no
 chaos, soft-deadline accounting only), ``chaos_full_barrier`` (chaos on,
 quorum off, generous retries so drops don't evict), ``chaos_quorum``
-(chaos on, quorum=N-1, hedging on).
+(chaos on, quorum=N-1, hedging on), and ``chaos_stream`` (the quorum run
+again over the persistent FitStream transport, DSGD_STREAM — proving
+quorum/hedging/eviction semantics survive on streams: stream writes eat
+the same seeded weather, per-frame drops expire like unary deadlines,
+chaos stream teardowns fall back to unary and re-open, hedges stay
+unary, and the run must complete with zero live-worker evictions inside
+the same loss-parity gate).
 
 Run: ``python bench.py --chaos [--smoke]``.  Prints exactly ONE JSON
 line on stdout; diagnostics to stderr; gated round-over-round through
@@ -76,6 +82,12 @@ _COUNTERS = (
     "chaos.injected.delay",
     "chaos.injected.dup",
     "chaos.injected.partition",
+    "chaos.injected.stream_teardown",
+    "master.sync.stream.sends",
+    "master.sync.stream.expired",
+    "master.sync.stream.broken",
+    "master.sync.stream.fallback",
+    "master.sync.stream.late",
 )
 
 
@@ -108,7 +120,7 @@ def _build(cfg: dict):
 
 
 def _run(train, test, make_model_fn, cfg: dict, *, chaos=None, quorum=None,
-         soft_s=None, grad_retries=1, label="") -> dict:
+         soft_s=None, grad_retries=1, stream=False, label="") -> dict:
     from distributed_sgd_tpu.core.cluster import DevCluster
 
     before = _snapshot()
@@ -126,7 +138,7 @@ def _run(train, test, make_model_fn, cfg: dict, *, chaos=None, quorum=None,
             max_epochs=cfg["epochs"], batch_size=cfg["batch"],
             learning_rate=cfg["lr"], grad_timeout_s=cfg["grad_timeout_s"],
             grad_retries=grad_retries, quorum=quorum,
-            straggler_soft_s=soft_s,
+            straggler_soft_s=soft_s, stream=stream,
         )
         survivors = len(c.master._workers)
     wall_s = time.perf_counter() - t0
@@ -179,12 +191,30 @@ def run_bench(smoke: bool = False) -> dict:
     chaos_q = _run(train, test, make, cfg, chaos=cfg["chaos"],
                    quorum=N_WORKERS - 1, soft_s=cfg["soft_s"],
                    label="chaos_quorum")
+    # the same weathered quorum fit over the persistent streams
+    # (DSGD_STREAM): quorum, hedging (always unary), per-frame drops, and
+    # chaos-injected stream teardowns with unary fallback + re-open all
+    # compose — semantics survive the transport swap
+    chaos_s = _run(train, test, make, cfg, chaos=cfg["chaos"],
+                   quorum=N_WORKERS - 1, soft_s=cfg["soft_s"], stream=True,
+                   label="chaos_stream")
+    ds = chaos_s["counters"]
+    log(f"stream transport under chaos: sends="
+        f"{ds['master.sync.stream.sends']} "
+        f"expired={ds['master.sync.stream.expired']} "
+        f"teardowns={ds['chaos.injected.stream_teardown']} "
+        f"broken={ds['master.sync.stream.broken']} "
+        f"fallbacks={ds['master.sync.stream.fallback']} "
+        f"late={ds['master.sync.stream.late']}")
 
     parity_bound = max(PARITY_REL * base["final_loss"],
                        base["final_loss"] + PARITY_ABS)
     parity_ok = chaos_q["final_loss"] <= parity_bound
     no_evictions = chaos_q["survivors"] == N_WORKERS
     completed = chaos_q["epochs_run"] == cfg["epochs"]
+    stream_parity_ok = chaos_s["final_loss"] <= parity_bound
+    stream_completed = (chaos_s["epochs_run"] == cfg["epochs"]
+                        and chaos_s["survivors"] == N_WORKERS)
     stall_x = chaos_off["stalled"] / max(1, chaos_q["stalled"])
     stall_ok = (chaos_off["stalled"] >= STALL_IMPROVEMENT_X
                 * max(1, chaos_q["stalled"]))
@@ -207,6 +237,16 @@ def run_bench(smoke: bool = False) -> dict:
         assert stall_ok, (
             f"quorum stalls {chaos_q['stalled']} not >= {STALL_IMPROVEMENT_X}x "
             f"fewer than full-barrier stalls {chaos_off['stalled']}")
+        assert stream_completed, (
+            f"chaos+quorum+stream fit lost workers or epochs "
+            f"({chaos_s['survivors']}/{N_WORKERS} left, "
+            f"{chaos_s['epochs_run']}/{cfg['epochs']} epochs) — "
+            f"quorum/eviction semantics must survive the stream transport")
+        assert stream_parity_ok, (
+            f"chaos+quorum+stream final loss {chaos_s['final_loss']:.6f} "
+            f"exceeds the parity bound {parity_bound:.6f}")
+        assert ds["master.sync.stream.sends"] > 0, (
+            "the stream row never actually streamed")
 
     return {
         "metric": f"chaos_sync_{label}",
@@ -231,6 +271,14 @@ def run_bench(smoke: bool = False) -> dict:
         "injected_partition_drops":
             chaos_q["counters"]["chaos.injected.partition"],
         "epoch_inflation_x_info": round(inflation, 2),
+        "stream_final_loss_info": round(chaos_s["final_loss"], 6),
+        "stream_completed": int(stream_completed),
+        "stream_parity_ok": int(stream_parity_ok),
+        "stream_sends": ds["master.sync.stream.sends"],
+        "stream_frame_expiries": ds["master.sync.stream.expired"],
+        "stream_teardowns": ds["chaos.injected.stream_teardown"],
+        "stream_fallbacks": ds["master.sync.stream.fallback"],
+        "stream_late_drops": ds["master.sync.stream.late"],
         "knobs_off_drift": drift,
         "baseline_wall_s_info": round(base["wall_s"], 2),
         "rounds_quorum": chaos_q["rounds"],

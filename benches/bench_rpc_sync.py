@@ -18,6 +18,17 @@ registry (utils/metrics.py master.sync.*):
                   asserted in --smoke (to 1e-6, observed 0);
 - ``pipelined`` — delta broadcast + K=4 local steps: the headline.
 
+Streaming transport rows (DSGD_STREAM, docs/SYNC_PIPELINE.md "Streaming
+transport"): interleaved stream-vs-unary fits at the RPC-BOUND shape —
+small batch, where the per-round floor is per-call unary overhead
+(HTTP/2 stream setup/teardown, metadata, future allocation), not the
+math.  Best-of-reps rounds/s each way, HARD-gated at >= 1.25x for the
+persistent-stream transport with weight drift 0.0 (identical math: same
+messages, same send-ordered decode — smoke additionally asserts the
+final losses agree to 1e-6 and that a knobs-off run never touches a
+stream instrument).  The ``*_rounds_per_s`` fields gate higher-is-better
+through benches/regress.py's throughput class.
+
 Run: ``python bench.py --rpc`` (or ``--rpc --smoke`` for the CI-sized
 corpus).  Prints exactly ONE JSON line on stdout; diagnostics go to
 stderr.  Results are gated round-over-round through benches/regress.py
@@ -41,6 +52,15 @@ FULL = dict(n=5120, n_features=47_236, nnz=76, batch=16, epochs=8, lr=0.5)
 SMOKE = dict(n=640, n_features=4096, nnz=8, batch=16, epochs=1, lr=0.5)
 K = 4
 N_WORKERS = 2
+# the RPC-bound shape for the streaming-transport rows: batch and dim so
+# small that the per-round floor is unary per-call overhead — the 2 KB
+# broadcast and the B=2 kernel are both far below the per-call cost, so
+# the rows measure the TRANSPORT.  128 rounds/epoch on a 256-row
+# partition.
+STREAM_SHAPE = dict(n=640, n_features=512, nnz=8, batch=2, lr=0.5)
+STREAM_EPOCHS = dict(smoke=2, full=4)
+STREAM_REPS = dict(smoke=2, full=3)
+STREAM_SPEEDUP_X = 1.25  # hard gate: stream rounds/s over unary rounds/s
 # convergence-parity bar, the exact gate style of the compression PR
 # (tests/test_compress.py::_assert_within_2pct / docs/COMPRESSION.md):
 # final train loss within 2% relative of the default path, with a 0.02
@@ -113,6 +133,112 @@ def _run(train, test, make_model_fn, cfg: dict, *, delta: bool, k: int) -> dict:
     }
 
 
+def _stream_run(train, test, make_model_fn, cfg: dict, epochs: int, *,
+                stream: bool):
+    """One small-batch fit on a fresh 2-worker cluster with kernels
+    prewarmed (the round floor under test is the TRANSPORT, not XLA
+    compile); returns (rounds/s, final weights, final loss, stream
+    counters delta)."""
+    import numpy as np
+
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.utils import metrics as mm
+
+    g = mm.global_metrics()
+    names = ("master.sync.rounds", "master.sync.stream.sends",
+             "master.sync.stream.opened", "master.sync.stream.broken",
+             "master.sync.stream.fallback")
+    before = {n: g.counter(n).value for n in names}
+    with DevCluster(make_model_fn(), train, test, n_workers=N_WORKERS,
+                    seed=0) as c:
+        zeros = np.zeros(train.n_features, dtype=np.float32)
+        warm = np.arange(cfg["batch"], dtype=np.int64)
+        for w in c.workers:
+            w.compute_gradient(zeros, warm)
+        # the master's per-epoch eval jit compiles on first use — warm it
+        # OUTSIDE the timed window so a 2-epoch run isn't half compile
+        c.master.local_loss(zeros)
+        c.master.local_loss(zeros, test=True)
+        t0 = time.perf_counter()
+        res = c.master.fit_sync(
+            max_epochs=epochs, batch_size=cfg["batch"],
+            learning_rate=cfg["lr"], stream=stream)
+        wall = time.perf_counter() - t0
+    d = {n: g.counter(n).value - before[n] for n in names}
+    return (d["master.sync.rounds"] / wall, np.asarray(res.state.weights),
+            float(res.losses[-1]), d)
+
+
+def stream_rows(smoke: bool) -> dict:
+    """Interleaved stream-vs-unary rounds/s at the RPC-bound shape; hard
+    asserts (both modes): >= STREAM_SPEEDUP_X throughput and weight drift
+    exactly 0.0 (smoke additionally asserts losses to 1e-6 and zero
+    stream-instrument movement on the knobs-off runs)."""
+    label = "smoke" if smoke else "full"
+    cfg = STREAM_SHAPE
+    epochs = STREAM_EPOCHS[label]
+    reps = STREAM_REPS[label]
+    log(f"stream transport rows ({label}): n={cfg['n']} "
+        f"dim={cfg['n_features']} batch={cfg['batch']} epochs={epochs} "
+        f"reps={reps} workers={N_WORKERS} (RPC-bound shape)")
+    train, test, make = _build(dict(cfg, epochs=epochs))
+    best_u = best_s = 0.0
+    w_u = w_s = None
+    loss_u = loss_s = None
+    unary_counters = {}
+    stream_counters = {}
+    for rep in range(reps):  # interleaved: noise hits both transports
+        ru, w_u, loss_u, du = _stream_run(train, test, make, cfg, epochs,
+                                          stream=False)
+        rs, w_s, loss_s, ds = _stream_run(train, test, make, cfg, epochs,
+                                          stream=True)
+        for k_, v in du.items():
+            unary_counters[k_] = unary_counters.get(k_, 0) + v
+        stream_counters = ds
+        best_u, best_s = max(best_u, ru), max(best_s, rs)
+        log(f"  rep {rep}: unary {ru:.0f} rounds/s, stream {rs:.0f} rounds/s")
+    import numpy as np
+
+    drift = float(np.max(np.abs(w_u - w_s)))
+    speedup = best_s / max(1e-9, best_u)
+    log(f"stream transport: unary {best_u:.0f} vs stream {best_s:.0f} "
+        f"rounds/s = {speedup:.2f}x (bar >= {STREAM_SPEEDUP_X}x); "
+        f"weight drift {drift}; loss {loss_u:.6f} vs {loss_s:.6f}; "
+        f"sends={stream_counters['master.sync.stream.sends']} "
+        f"broken={stream_counters['master.sync.stream.broken']} "
+        f"fallback={stream_counters['master.sync.stream.fallback']}")
+    assert drift == 0.0, (
+        f"stream transport drifted the weights by {drift} — the framed "
+        f"messages are the unary messages and decode is send-ordered, so "
+        f"the math must be bit-identical")
+    assert speedup >= STREAM_SPEEDUP_X, (
+        f"stream transport {speedup:.2f}x not >= {STREAM_SPEEDUP_X}x over "
+        f"unary at the RPC-bound shape ({best_s:.0f} vs {best_u:.0f} "
+        f"rounds/s)")
+    if smoke:
+        assert abs(loss_s - loss_u) <= 1e-6, (
+            f"stream loss {loss_s} != unary loss {loss_u} at 1e-6")
+        # knobs-off identity, the counter half (the wire-byte half lives
+        # in tests/test_stream.py): unary fits never touch a stream
+        for name in ("master.sync.stream.sends",
+                     "master.sync.stream.opened"):
+            assert unary_counters[name] == 0, (
+                f"knobs-off run moved {name} (= {unary_counters[name]})")
+        assert stream_counters["master.sync.stream.sends"] > 0
+    return {
+        "unary_rounds_per_s": round(best_u, 1),
+        "stream_rounds_per_s": round(best_s, 1),
+        "stream_speedup_x": round(speedup, 2),
+        "stream_loss_drift": drift,
+        "stream_final_loss_info": round(loss_s, 6),
+        "stream_sends": stream_counters["master.sync.stream.sends"],
+        "stream_broken": stream_counters["master.sync.stream.broken"],
+        "stream_fallbacks": stream_counters["master.sync.stream.fallback"],
+        "stream_batch": cfg["batch"],
+        "stream_epochs": epochs,
+    }
+
+
 def run_bench(smoke: bool = False) -> dict:
     cfg = SMOKE if smoke else FULL
     label = "smoke" if smoke else "full"
@@ -160,6 +286,8 @@ def run_bench(smoke: bool = False) -> dict:
             f"pipelined final loss {piped['final_loss']:.6f} exceeds the "
             f"parity bound {parity_bound:.6f} (default "
             f"{dense['final_loss']:.6f})")
+    stream = stream_rows(smoke)
+
     sends = piped["counters"]
     hits = (sends["master.sync.bcast.delta"]
             + sends["master.sync.bcast.cached"])
@@ -195,6 +323,7 @@ def run_bench(smoke: bool = False) -> dict:
         "loss_parity_bound_info": round(parity_bound, 6),
         "local_steps": K,
         "n_workers": N_WORKERS,
+        **stream,
         **{k_: v for k_, v in cfg.items()},
     }
 

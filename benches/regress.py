@@ -75,12 +75,20 @@ DEFAULT_TOLERANCE = 0.35  # shared-chip variance headroom (TIMING metrics)
 #   own >= 2x cold/warm hard assert is the load-bearing gate; 50% fails
 #   a genuinely broken fast path (a warm join that compiles again
 #   roughly triples) without false-alarming on build-host jitter;
+# - round throughput (`*_rounds_per_s`, the rpc-bench streaming rows):
+#   HIGHER is better — the suffix ends in `_s`, which the naive
+#   lower-is-better timing rule would gate BACKWARDS (treating a
+#   throughput gain as a regression and a collapse as an improvement);
+#   direction() resolves `_per_s` first, and this class entry pins the
+#   pairing explicitly so the rule can never silently reorder.  The
+#   35% band matches the loopback-RPC timing variance the rows measure;
 # - everything else (seconds, rates, `value`): the 35% shared-chip knob.
 CLASS_TOLERANCES = (
     (("_loss", "_acc"), 0.02),
     (("_bytes",), 0.10),
     (("_p50_s", "_p99_s"), 0.50),
     (("_spinup_s",), 0.50),
+    (("_rounds_per_s",), 0.35),
 )
 
 

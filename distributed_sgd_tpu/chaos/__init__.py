@@ -510,8 +510,67 @@ class _ChaosCallable:
         return fut
 
 
+class _ChaosStreamCallable:
+    """Wraps one stream-stream multicallable: the plan applies to every
+    OUTGOING message of the request stream, so stream writes see exactly
+    the drop/delay/dup/partition weather unary calls do
+    (docs/SYNC_PIPELINE.md "Streaming transport").
+
+    Per-message semantics mirror the unary actions on an ordered pipe:
+
+    - drop/partition: the frame is silently not written — the caller's
+      per-frame deadline is the only way out, exactly a lost packet
+      (later frames still flow: a lost frame is not a dead stream);
+    - delay: the writer sleeps before the frame (and, as on a real
+      ordered transport, everything queued behind it waits too);
+    - dup: the frame is written twice — receivers must drop the second
+      reply idempotently (rpc/stream.py does, by seq);
+    - error: the request iterator raises, which gRPC surfaces as a
+      TERMINATED stream — the client's reader sees the teardown and the
+      transport falls back to unary (the failure mode a mid-stream
+      connection reset produces).
+
+    Decisions draw from the same deterministic per-(origin, target,
+    method) RNG stream as unary calls, one draw set per message.
+    """
+
+    def __init__(self, inner, method: str, target, origin, state: ChaosState):
+        self._inner = inner
+        self._method = method
+        self._target = target
+        self._origin = origin
+        self._state = state
+        # reuse the unary decision procedure (same fixed draw order)
+        self._decider = _ChaosCallable(None, method, target, origin, state)
+
+    def _wrap(self, request_iterator):
+        st = self._state
+        edge = {"method": self._method}
+        for msg in request_iterator:
+            action, param = self._decider._decide()
+            if action == "drop":
+                continue  # lost frame; the stream itself stays healthy
+            if action == "error":
+                st.count("stream_teardown",
+                         origin=st._canonical(self._origin),
+                         target=st._canonical(self._target), **edge)
+                raise ChaosRpcError(grpc.StatusCode.UNAVAILABLE,
+                                    "chaos stream teardown")
+            if action == "dup":
+                yield msg
+                yield msg
+                continue
+            if action == "delay":
+                time.sleep(param)
+            yield msg
+
+    def __call__(self, request_iterator, timeout=None, **kwargs):
+        return self._inner(self._wrap(request_iterator), timeout=timeout,
+                           **kwargs)
+
+
 class ChaosChannel:
-    """Channel proxy whose unary_unary multicallables inject the plan."""
+    """Channel proxy whose multicallables inject the plan."""
 
     def __init__(self, inner: grpc.Channel, target, origin, state: ChaosState):
         self._inner = inner
@@ -527,6 +586,15 @@ class ChaosChannel:
         method = path.rsplit("/", 1)[-1]
         return _ChaosCallable(call, method, self._target, self._origin,
                               self._state)
+
+    def stream_stream(self, path, request_serializer=None,
+                      response_deserializer=None, **kwargs):
+        call = self._inner.stream_stream(
+            path, request_serializer=request_serializer,
+            response_deserializer=response_deserializer, **kwargs)
+        method = path.rsplit("/", 1)[-1]
+        return _ChaosStreamCallable(call, method, self._target, self._origin,
+                                    self._state)
 
     def close(self) -> None:
         self._inner.close()

@@ -177,6 +177,16 @@ class Config:
     # automatic full-broadcast fallback on any mismatch.
     local_steps: int = 1  # sync rpc: K local SGD steps per round
     delta_broadcast: bool = False  # sync rpc: versioned sparse weight broadcasts
+    # streaming RPC fan-out (docs/SYNC_PIPELINE.md "Streaming transport"):
+    # sync Gradient requests/replies ride ONE persistent bidirectional
+    # FitStream per (master, worker) pair instead of one unary call per
+    # worker per round, with the encode-ahead thread pre-staging each
+    # worker's next request frame.  Bit-identical math (the rpc bench
+    # gates drift 0.0); a broken stream falls back to unary per worker
+    # (breaker-fed), and older worker binaries answering UNIMPLEMENTED
+    # stay unary (mixed fleets keep working).  Off (default): no Frame is
+    # ever constructed and the wire stays byte-identical to the seed.
+    stream: bool = False  # sync rpc: persistent per-worker gradient streams
     # tensor parallelism: shard the blocked weight rows over F feature
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
@@ -513,6 +523,7 @@ class Config:
             compress_ef=_env("DSGD_COMPRESS_EF", cls.compress_ef, bool),
             local_steps=_env("DSGD_LOCAL_STEPS", cls.local_steps, int),
             delta_broadcast=_env("DSGD_DELTA_BROADCAST", cls.delta_broadcast, bool),
+            stream=_env("DSGD_STREAM", cls.stream, bool),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
             host_devices=_env("DSGD_HOST_DEVICES", cls.host_devices, int),
             compile_cache=_env("DSGD_COMPILE_CACHE", None, str),

@@ -363,6 +363,31 @@ class _BroadcastState:
         self._w_prev: Optional[np.ndarray] = None
         self._full_msg = None     # encoded lazily, once per version
         self._delta_msg = None    # pb.WeightDelta, False = dense fallback
+        # pre-staged round dispatch (DSGD_STREAM, docs/SYNC_PIPELINE.md
+        # "Streaming transport"): with staging armed (stage_for), the
+        # encoder thread ALSO builds each worker's next request frame —
+        # weight arm attached, version stamped — so when the window
+        # barrier closes, dispatch is one sample draw + one stream write
+        # per worker with zero weight re-serialization on the critical
+        # path.  Entries carry the assumptions they were built under
+        # (version, the worker's acknowledged version) and are discarded
+        # when reality moved (stale fallback, resplit, retry window).
+        self._stage_keys: list = []
+        self._stage_ctx: Optional[Tuple[int, int, int, float]] = None
+        self._stage_lock = threading.Lock()
+        self._staged: Dict[Tuple[str, int], tuple] = {}
+
+    def stage_for(self, keys, fit_token: int, local_steps: int,
+                  batch_size: int, learning_rate: float) -> None:
+        """Arm (or re-arm after a membership change) frame staging for
+        `keys`; takes effect from the next advance().  Only the streaming
+        dispatch path ever calls this — the unary plane keeps populate()
+        and its call graph untouched."""
+        self._stage_keys = list(keys)
+        self._stage_ctx = (int(fit_token), int(local_steps),
+                           int(batch_size), float(learning_rate))
+        with self._stage_lock:
+            self._staged = {}
 
     def advance(self, w_new: np.ndarray, w_old: np.ndarray) -> None:
         """Weights moved: bump the version, invalidate encoded forms, and
@@ -371,6 +396,8 @@ class _BroadcastState:
         self._w_prev = w_old
         self._full_msg = None
         self._delta_msg = None
+        with self._stage_lock:
+            self._staged = {}
         if not self.encode_ahead:
             return
         if self._enc_pool is None:
@@ -388,12 +415,54 @@ class _BroadcastState:
     def _preencode(self, w: np.ndarray) -> None:
         """Encoder-thread body: build the forms `populate` will need —
         results land in the same lazy slots, `_join_encode` gives the
-        happens-before edge."""
+        happens-before edge — then stage per-worker request frames when
+        staging is armed (both slots are set by then, so _attach_arm
+        never joins from the encoder thread itself)."""
         full = codec.encode_tensor(w)
         if self.delta_broadcast:
             # False ("use the full form") is itself a computed result
             self._delta_msg = self._compute_delta(w)
         self._full_msg = full
+        if self._stage_keys and self._stage_ctx is not None:
+            self._build_staged(w)
+
+    def _build_staged(self, w: np.ndarray) -> None:
+        """Encoder-thread tail: one ready-to-send Frame per staged worker
+        for the NEXT window.  Wire accounting stays at dispatch time
+        (take_staged_frame), so counters equal the populate() path's."""
+        token, k, bs, lr = self._stage_ctx
+        version = self.version
+        staged = {}
+        for key in self._stage_keys:
+            frame = pb.Frame()
+            req = frame.request
+            req.fit_token = token
+            if k > 1:
+                req.local_steps = k
+                req.batch_size = bs
+                req.learning_rate = lr
+            assumed = self._worker_ver.get(key)
+            form, nbytes = self._attach_arm(req, key, w)
+            staged[key] = (frame, form, nbytes, assumed, version)
+        with self._stage_lock:
+            self._staged = staged
+
+    def take_staged_frame(self, key):
+        """The pre-staged frame for `key` if its staging assumptions still
+        hold (same broadcast version, same acknowledged worker version);
+        None otherwise — the caller builds and populates a fresh frame.
+        Joins the encoder first, exactly like populate()'s lazy reads, and
+        accounts the send here so metrics match the unary path."""
+        self._join_encode()
+        with self._stage_lock:
+            item = self._staged.pop(key, None)
+        if item is None:
+            return None
+        frame, form, nbytes, assumed, version = item
+        if version != self.version or self._worker_ver.get(key) != assumed:
+            return None  # stale fallback / resplit moved under the stage
+        metrics_mod.record_broadcast(self.metrics, form, nbytes)
+        return frame
 
     def _join_encode(self) -> None:
         f = self._enc_future
@@ -417,31 +486,40 @@ class _BroadcastState:
     def populate(self, req, key, w: np.ndarray) -> None:
         """Attach the cheapest valid weight arm for worker `key` to `req`
         and account it (utils/metrics.py master.sync.bcast.*)."""
+        form, nbytes = self._attach_arm(req, key, w)
+        metrics_mod.record_broadcast(self.metrics, form, nbytes)
+
+    def _attach_arm(self, req, key, w: np.ndarray):
+        """Choose + attach the weight arm for `key`; returns the
+        (form, bytes) pair the caller accounts.  Shared by populate()
+        (dispatch thread, joins the encoder through the lazy slot reads)
+        and _build_staged (encoder thread, slots already set)."""
         if not self.delta_broadcast:
             full = self._full(w)
             req.weights.CopyFrom(full)
             if self.versioned:
                 req.step_version = self.version
-            metrics_mod.record_broadcast(self.metrics, "full", full.ByteSize())
-            return
+            return "full", full.ByteSize()
         req.step_version = self.version
         wv = self._worker_ver.get(key)
         if wv == self.version:
-            metrics_mod.record_broadcast(self.metrics, "cached", 0)
-            return
+            return "cached", 0
         if wv is not None and wv == self.version - 1:
             delta = self._delta(w)
             if delta is not None:
                 req.delta.CopyFrom(delta)
-                metrics_mod.record_broadcast(
-                    self.metrics, "delta", delta.ByteSize())
-                return
+                return "delta", delta.ByteSize()
         full = self._full(w)
         req.weights.CopyFrom(full)
-        metrics_mod.record_broadcast(self.metrics, "full", full.ByteSize())
+        return "full", full.ByteSize()
 
     def _full(self, w: np.ndarray):
-        self._join_encode()
+        # slot first, join only on a miss: a set slot IS the encoder's
+        # finished result (it is assigned last), and checking first lets
+        # the encoder thread itself resolve forms while staging frames
+        # without deadlocking on its own future
+        if self._full_msg is None:
+            self._join_encode()
         if self._full_msg is None:
             self._full_msg = codec.encode_tensor(w)
         return self._full_msg
@@ -458,7 +536,8 @@ class _BroadcastState:
         return False if delta is None else delta
 
     def _delta(self, w: np.ndarray):
-        self._join_encode()
+        if self._delta_msg is None:
+            self._join_encode()
         if self._delta_msg is None:
             self._delta_msg = self._compute_delta(w)
         return self._delta_msg or None
@@ -499,6 +578,20 @@ class MasterNode:
         self._workers: Dict[Tuple[str, int], WorkerStub] = {}
         self._channels: Dict[Tuple[str, int], grpc.Channel] = {}
         self._order: List[Tuple[str, int]] = []  # registration order
+        # persistent per-worker gradient streams (DSGD_STREAM,
+        # docs/SYNC_PIPELINE.md "Streaming transport"): opened lazily by
+        # the first streamed dispatch of a fit, closed at fit end /
+        # unregister / stop.  Empty forever when no fit runs with
+        # stream=True — the knobs-off call graph never touches FitStream
+        # (asserted by tests/test_stream.py).
+        self._streams: Dict[Tuple[str, int], object] = {}
+        self._streams_lock = threading.Lock()
+        # peers whose binary answered UNIMPLEMENTED to FitStream: skew is
+        # per PROCESS, not per fit — the set outlives the fit-scoped
+        # clients above (harvested in _close_streams) so a later fit never
+        # re-probes a known-old binary.  Cleared per peer on unregister: a
+        # worker restarting on the same endpoint may run a NEW binary.
+        self._stream_unsupported: set = set()
         # host shapes (docs/HIERARCHY.md): local device count each worker
         # reported at registration (Node.devices; 0/absent = flat single-
         # device worker).  Feeds the host-granular weighted split below.
@@ -663,6 +756,7 @@ class MasterNode:
         self._hb_stop.set()
         self._async_running.clear()
         self._async_done.set()
+        self._close_streams()
         if self.telemetry_exporter is not None:
             self.telemetry_exporter.stop()
         self.server.stop(grace=1.0)
@@ -763,6 +857,15 @@ class MasterNode:
         if evicted:
             flight.record("worker.evicted", worker=f"{host}:{port}")
             flight.dump("eviction")
+        # the departed worker's gradient stream dies with its membership
+        # (its channel closes below; a half-open stream would otherwise
+        # pin pending futures until their frame deadlines), and its skew
+        # marker clears — a same-endpoint rejoin may be a newer binary
+        with self._streams_lock:
+            stream = self._streams.pop(key, None)
+            self._stream_unsupported.discard(key)
+        if stream is not None:
+            stream.close()
         if self.telemetry is not None:
             # a departed worker's series leave the cluster exposition with
             # its membership (its final snapshot would otherwise pin stale
@@ -814,6 +917,82 @@ class MasterNode:
 
     def _stubs(self) -> List[WorkerStub]:
         return [stub for _, stub in self._members()]
+
+    # -- streaming fan-out (DSGD_STREAM; docs/SYNC_PIPELINE.md) ------------
+
+    def _grad_stream(self, key, stub):
+        """The live FitStream client for `key`, (re)opened lazily.
+
+        The hot path is one lock-free dict read + three flag reads; the
+        slow path returns None — sending goes unary — when the peer is
+        marked unsupported (an older binary answered UNIMPLEMENTED: skew
+        does not heal mid-process, so the marker survives the fit-scoped
+        client in `_stream_unsupported` until the peer re-registers),
+        when its breaker is suppressing (every stream teardown fed it one
+        failure, so a flapping peer degrades to unary until the breaker's
+        half-open probe heals it), or when the channel is gone
+        (unregistered under us)."""
+        s = self._streams.get(key)
+        if s is not None and s.usable:
+            return s
+        from distributed_sgd_tpu.rpc.stream import FitStreamClient
+
+        with self._streams_lock:
+            if key in self._stream_unsupported:
+                return None
+            s = self._streams.get(key)
+            if s is not None:
+                if s.usable:
+                    return s
+                if s.unsupported:
+                    self._stream_unsupported.add(key)
+                    return None
+                self._streams.pop(key, None)  # broken: replace below
+            if self.rpc_policy.breaker(key).suppressed():
+                return None
+            with self._members_lock:
+                if key not in self._workers:
+                    return None
+            breaker = self.rpc_policy.breaker(key)
+            try:
+                s = FitStreamClient(
+                    stub.FitStream, peer=f"{key[0]}:{key[1]}",
+                    metrics=self.metrics, log=self.log,
+                    on_break=breaker.record_failure)
+            except Exception:  # noqa: BLE001 - channel closed under us
+                return None  # this window goes unary; the barrier classifies
+            self._streams[key] = s
+            return s
+
+    def _close_streams(self) -> None:
+        with self._streams_lock:
+            streams, self._streams = dict(self._streams), {}
+            # skew outlives the fit-scoped clients: a later fit must not
+            # re-probe a peer whose binary already answered UNIMPLEMENTED
+            for k, s in streams.items():
+                if s.unsupported:
+                    self._stream_unsupported.add(k)
+        for s in streams.values():
+            s.close()
+
+    def _dispatch_gradient(self, key, stub, frame, req, timeout_s: float,
+                           use_stream: bool):
+        """One window's Gradient send for one worker: a frame write down
+        the persistent stream (wrapped so a stream teardown transparently
+        replays the request over unary with the remaining deadline), or
+        the classic unary future.  Returns a future-alike or None (the
+        channel closed under us — the barrier classifies it)."""
+        if use_stream and frame is not None:
+            s = self._grad_stream(key, stub)
+            if s is not None:
+                fut = s.send(frame, timeout_s,
+                             unary_call=stub.Gradient, request=req)
+                if fut is not None:
+                    return fut
+        try:
+            return stub.Gradient.future(req, timeout=timeout_s)
+        except ValueError:  # channel closed under us
+            return None
 
     # -- distributed eval (Master.scala:61-98) -----------------------------
 
@@ -1042,6 +1221,7 @@ class MasterNode:
         fit_state_path: Optional[str] = None,
         fit_state_every: int = 0,
         health=None,
+        stream: bool = False,
     ) -> FitResult:
         """Fault-tolerant sync fit, with an optional pipelined wire path.
 
@@ -1084,6 +1264,21 @@ class MasterNode:
           pseudo-gradient (mean_delta / learning_rate) through the same
           optimizer surface — K x fewer barriers and broadcasts per epoch,
           local-SGD semantics (Stich, 2018) between them.
+        - `stream=True` (DSGD_STREAM, "Streaming transport"): every
+          window's GradientRequest rides ONE persistent bidirectional
+          FitStream per worker instead of a fresh unary call, with the
+          encode-ahead thread pre-staging each worker's next request
+          frame — dispatch becomes one sample draw + one stream write per
+          worker, amortizing per-call HTTP/2 setup/teardown, metadata,
+          and future allocation over the whole fit.  The math is
+          bit-identical to the unary plane (same messages, same
+          send-ordered decode; the rpc bench gates drift 0.0), a broken
+          stream transparently replays its window over unary and feeds
+          the same per-peer breaker, UNIMPLEMENTED peers (older binaries)
+          stay unary permanently, and hedges are ALWAYS unary — they
+          target a different worker than the stream's owner, and every
+          quorum fire re-proves the interop path.  Off (default): no
+          Frame is ever constructed, call graph byte-identical.
 
         Quorum barrier (DSGD_QUORUM, docs/FAULT_TOLERANCE.md; Chen et al.
         2016's N+b backup-replica shape): with `quorum=Q` the window
@@ -1158,6 +1353,13 @@ class MasterNode:
         # wire: the EF rollback mask keys on step_version
         bcast = _BroadcastState(delta_broadcast, self.metrics,
                                 versioned=quorum is not None)
+        use_stream = bool(stream)
+        if use_stream:
+            # pre-staged round dispatch: from the first advance() on, the
+            # encoder thread builds each worker's next request frame while
+            # the current window's replies are still in flight
+            bcast.stage_for(keys, fit_token, local_steps, batch_size,
+                            learning_rate)
         # allocation-free fan-in: one dim-sized accumulator reused by every
         # window instead of a (workers x dim) dense stack per barrier
         grad_acc = np.zeros(self.model.n_features, dtype=np.float32)
@@ -1294,293 +1496,325 @@ class MasterNode:
 
         rounds_since_save = 0
         stopped_early = False
-        for epoch in range(start_epoch, max_epochs):
-            t0 = time.perf_counter()
-            batch = 0
-            # keyed by absolute epoch: a resumed run draws the same per-epoch
-            # sample stream a fresh run would (mirrors SyncTrainer's
-            # fold_in(base_key, epoch))
-            rng = np.random.default_rng((self.seed, epoch))
-            if resume_rng_state is not None:
-                # crash-safe resume lands MID-epoch: restore the generator
-                # to its snapshotted state and continue from the window
-                # cursor — the remaining windows draw the identical sample
-                # stream the uninterrupted run would have drawn
-                rng.bit_generator.state = resume_rng_state
-                batch = resume_batch
-                resume_rng_state = None
-            while batch < max_samples:
-                # live membership: heartbeat-driven unregister_worker (or a
-                # graceful leave) reaches the loop here, not at fit start
-                current = self._members()
-                if [k for k, _ in current] != keys:
-                    if not current:
-                        raise RuntimeError("all workers lost mid-fit")
-                    members, keys = current, [k for k, _ in current]
-                    parts = self._split_parts(split, members)
-                    max_samples = max(len(p) for p in parts)
-                    bcast.forget_missing(keys)  # rejoins start from full
-                    # host-local workers absorb the new partition bounds
-                    # themselves: ids outside a resident slice trigger the
-                    # worker-side incremental reload (O(delta) rows through
-                    # its RowReader) or the classified foreign-id refusal
-                    self.metrics.counter(metrics_mod.SYNC_RESPLITS).increment()
-                    flight.record("sync.resplit", members=len(members))
-                    self.log.warning("membership changed; re-split across %d workers",
-                                     len(members))
-                    if batch >= max_samples:
-                        break
-                t_batch = time.perf_counter()
-                # one trace per fan-out window (trace/; NOOP when tracing
-                # is off or this round is not head-sampled): worker
-                # Gradient calls — hedges and retries included — become
-                # client/server child spans of this root via the stub and
-                # servicer hooks in rpc/service.py, and quorum/chaos
-                # events attach inside it (docs/OBSERVABILITY.md)
-                wspan = trace_mod.root_span(
-                    trace_mod.SPAN_SYNC_WINDOW, node="master", epoch=epoch,
-                    batch=int(batch), version=bcast.version)
-                with wspan:
-                    if not scatter_evented:
-                        trace_mod.event(trace_mod.EVENT_SCATTER_SELECTED,
-                                        formulation=scatter_form)
-                        scatter_evented = True
-                    futs = []
-                    ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
-                    rb_sent: Dict[Tuple[str, int], int] = {}
-                    # overlapped fan-in (full barrier only): zero the
-                    # accumulator BEFORE the fan-out so each reply's
-                    # scatter-decode runs in its arrival callback,
-                    # send-ordered — only the slowest reply's decode stays
-                    # on the critical path.  The quorum barrier keeps its
-                    # post-barrier decode: its contributor set (hedge wins,
-                    # late originals) is only known once the round closes.
-                    decoder = None
-                    if quorum is None:
-                        grad_acc.fill(0.0)
-                        decoder = _ArrivalDecoder(grad_acc)
-                    for (key, stub), part in zip(members, parts):
-                        ids = _draw_ids(rng, part, batch, window_span)
-                        ids_by_key[key] = ids
-                        req = pb.GradientRequest(
-                            samples=ids.astype(np.int32), fit_token=fit_token)
-                        if local_steps > 1:
-                            req.local_steps = local_steps
-                            req.batch_size = batch_size
-                            req.learning_rate = learning_rate
-                        rb = ef_rollback.pop(key, None)
-                        if rb is not None:
-                            req.ef_rollback_version = rb
-                            rb_sent[key] = rb  # re-armed if this request fails
-                        bcast.populate(req, key, w)
-                        try:
-                            fut = stub.Gradient.future(req, timeout=grad_timeout_s)
-                        except ValueError:  # channel closed under us
-                            fut = None
-                        futs.append((key, fut))
-                        if decoder is not None:
-                            decoder.watch(len(futs) - 1, fut)
-                    if quorum is None:
-                        # barrier, with deadlines; receive-side wire accounting
-                        # happens per arriving reply inside _await_futures (send-
-                        # side comms.* counters live in the workers' compressors),
-                        # so discarded/retried windows are accounted too
-                        ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
-                        decoder.finish(futs)
-                        good, stale = [], []
-                        for key, reply in ok:
-                            (stale if reply.stale_version else good).append((key, reply))
-                        replies = [r for _, r in good]
-                        satisfied = False
-                        # pure observation when a soft deadline is configured
-                        # without quorum: how often would the quorum barrier
-                        # have had to intervene?  (bench_chaos.py's baseline)
-                        if (straggler_soft_s is not None
-                                and time.perf_counter() - t_batch > straggler_soft_s):
-                            stalled.increment()
-                    else:
-                        replies, good, stale, failed, satisfied = (
-                            self._quorum_barrier(
-                                futs, members, ids_by_key, quorum,
-                                straggler_soft_s, grad_timeout_s, fit_token,
-                                local_steps, batch_size, learning_rate, bcast,
-                                w, hedge, ef_rollback, grad_bytes, rb_sent))
+        # streams are fit-scoped: whatever path exits the epoch loop
+        # (completion, convergence, health halt, all-workers-lost,
+        # any exception), the persistent per-worker gradient streams
+        # close with the fit
+        try:
+            for epoch in range(start_epoch, max_epochs):
+                t0 = time.perf_counter()
+                batch = 0
+                # keyed by absolute epoch: a resumed run draws the same per-epoch
+                # sample stream a fresh run would (mirrors SyncTrainer's
+                # fold_in(base_key, epoch))
+                rng = np.random.default_rng((self.seed, epoch))
+                if resume_rng_state is not None:
+                    # crash-safe resume lands MID-epoch: restore the generator
+                    # to its snapshotted state and continue from the window
+                    # cursor — the remaining windows draw the identical sample
+                    # stream the uninterrupted run would have drawn
+                    rng.bit_generator.state = resume_rng_state
+                    batch = resume_batch
+                    resume_rng_state = None
+                while batch < max_samples:
+                    # live membership: heartbeat-driven unregister_worker (or a
+                    # graceful leave) reaches the loop here, not at fit start
+                    current = self._members()
+                    if [k for k, _ in current] != keys:
+                        if not current:
+                            raise RuntimeError("all workers lost mid-fit")
+                        members, keys = current, [k for k, _ in current]
+                        parts = self._split_parts(split, members)
+                        max_samples = max(len(p) for p in parts)
+                        bcast.forget_missing(keys)  # rejoins start from full
+                        if use_stream:
+                            # re-arm staging for the new membership; departed
+                            # workers' streams were closed by unregister, and
+                            # a (re)joined worker's stream re-opens lazily on
+                            # its first dispatch below
+                            bcast.stage_for(keys, fit_token, local_steps,
+                                            batch_size, learning_rate)
+                        # host-local workers absorb the new partition bounds
+                        # themselves: ids outside a resident slice trigger the
+                        # worker-side incremental reload (O(delta) rows through
+                        # its RowReader) or the classified foreign-id refusal
+                        self.metrics.counter(metrics_mod.SYNC_RESPLITS).increment()
+                        flight.record("sync.resplit", members=len(members))
+                        self.log.warning("membership changed; re-split across %d workers",
+                                         len(members))
+                        if batch >= max_samples:
+                            break
+                    t_batch = time.perf_counter()
+                    # one trace per fan-out window (trace/; NOOP when tracing
+                    # is off or this round is not head-sampled): worker
+                    # Gradient calls — hedges and retries included — become
+                    # client/server child spans of this root via the stub and
+                    # servicer hooks in rpc/service.py, and quorum/chaos
+                    # events attach inside it (docs/OBSERVABILITY.md)
+                    wspan = trace_mod.root_span(
+                        trace_mod.SPAN_SYNC_WINDOW, node="master", epoch=epoch,
+                        batch=int(batch), version=bcast.version)
+                    with wspan:
+                        if not scatter_evented:
+                            trace_mod.event(trace_mod.EVENT_SCATTER_SELECTED,
+                                            formulation=scatter_form)
+                            scatter_evented = True
+                        futs = []
+                        ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
+                        rb_sent: Dict[Tuple[str, int], int] = {}
+                        # overlapped fan-in (full barrier only): zero the
+                        # accumulator BEFORE the fan-out so each reply's
+                        # scatter-decode runs in its arrival callback,
+                        # send-ordered — only the slowest reply's decode stays
+                        # on the critical path.  The quorum barrier keeps its
+                        # post-barrier decode: its contributor set (hedge wins,
+                        # late originals) is only known once the round closes.
+                        decoder = None
+                        if quorum is None:
+                            grad_acc.fill(0.0)
+                            decoder = _ArrivalDecoder(grad_acc)
+                        for (key, stub), part in zip(members, parts):
+                            ids = _draw_ids(rng, part, batch, window_span)
+                            ids_by_key[key] = ids
+                            frame = None
+                            req = None
+                            if use_stream:
+                                # pre-staged dispatch: the encoder thread
+                                # already built this worker's frame (weight
+                                # arm attached) during the previous barrier —
+                                # dispatch adds the sample draw and writes
+                                frame = bcast.take_staged_frame(key)
+                            if frame is not None:
+                                req = frame.request
+                                req.samples.extend(ids.astype(np.int32))
+                            else:
+                                if use_stream:
+                                    frame = pb.Frame()
+                                    req = frame.request
+                                    req.samples.extend(ids.astype(np.int32))
+                                    req.fit_token = fit_token
+                                else:
+                                    req = pb.GradientRequest(
+                                        samples=ids.astype(np.int32),
+                                        fit_token=fit_token)
+                                if local_steps > 1:
+                                    req.local_steps = local_steps
+                                    req.batch_size = batch_size
+                                    req.learning_rate = learning_rate
+                                bcast.populate(req, key, w)
+                            rb = ef_rollback.pop(key, None)
+                            if rb is not None:
+                                req.ef_rollback_version = rb
+                                rb_sent[key] = rb  # re-armed if this request fails
+                            fut = self._dispatch_gradient(
+                                key, stub, frame, req, grad_timeout_s, use_stream)
+                            futs.append((key, fut))
+                            if decoder is not None:
+                                decoder.watch(len(futs) - 1, fut)
+                        if quorum is None:
+                            # barrier, with deadlines; receive-side wire accounting
+                            # happens per arriving reply inside _await_futures (send-
+                            # side comms.* counters live in the workers' compressors),
+                            # so discarded/retried windows are accounted too
+                            ok, failed = _await_futures(futs, bytes_counter=grad_bytes)
+                            decoder.finish(futs)
+                            good, stale = [], []
+                            for key, reply in ok:
+                                (stale if reply.stale_version else good).append((key, reply))
+                            replies = [r for _, r in good]
+                            satisfied = False
+                            # pure observation when a soft deadline is configured
+                            # without quorum: how often would the quorum barrier
+                            # have had to intervene?  (bench_chaos.py's baseline)
+                            if (straggler_soft_s is not None
+                                    and time.perf_counter() - t_batch > straggler_soft_s):
+                                stalled.increment()
+                        else:
+                            replies, good, stale, failed, satisfied = (
+                                self._quorum_barrier(
+                                    futs, members, ids_by_key, quorum,
+                                    straggler_soft_s, grad_timeout_s, fit_token,
+                                    local_steps, batch_size, learning_rate, bcast,
+                                    w, hedge, ef_rollback, grad_bytes, rb_sent))
+                            if not satisfied:
+                                # below-quorum degradation: the barrier fell back
+                                # to the classic full barrier — dump the flight
+                                # ring so the window leaves evidence even when
+                                # the fit later recovers (docs/OBSERVABILITY.md)
+                                flight.record(
+                                    "quorum.below", epoch=epoch, batch=int(batch),
+                                    version=bcast.version, got=len(good),
+                                    quorum=min(quorum, len(members)))
+                                # throttled: a minutes-long partition degrades
+                                # EVERY window — keep evidence fresh without
+                                # blocking the barrier loop on disk each round
+                                flight.dump("below_quorum", min_interval_s=10.0)
+                        rounds.increment()
+                        for key, _ in good:
+                            tracker.record_ok(key)
+                            bcast.note_ok(key)
+                        for key, _ in stale:
+                            # a stale reply is still a LIVE worker: reset its
+                            # failure count (the pre-quorum code treated every ok
+                            # reply as liveness evidence)
+                            tracker.record_ok(key)
+                            # replica mismatch (restart, missed window): full
+                            # broadcast on the retry — the correctness fallback
+                            bcast.note_stale(key)
+                            self.metrics.counter(metrics_mod.SYNC_STALE).increment()
+                            trace_mod.event(trace_mod.EVENT_BCAST_STALE,
+                                            worker=f"{key[0]}:{key[1]}")
+                            self.log.warning(
+                                "worker %s:%d replica stale at v%d; falling back to "
+                                "full broadcast", key[0], key[1], bcast.version)
                         if not satisfied:
-                            # below-quorum degradation: the barrier fell back
-                            # to the classic full barrier — dump the flight
-                            # ring so the window leaves evidence even when
-                            # the fit later recovers (docs/OBSERVABILITY.md)
-                            flight.record(
-                                "quorum.below", epoch=epoch, batch=int(batch),
-                                version=bcast.version, got=len(good),
-                                quorum=min(quorum, len(members)))
-                            # throttled: a minutes-long partition degrades
-                            # EVERY window — keep evidence fresh without
-                            # blocking the barrier loop on disk each round
-                            flight.dump("below_quorum", min_interval_s=10.0)
-                    rounds.increment()
-                    for key, _ in good:
-                        tracker.record_ok(key)
-                        bcast.note_ok(key)
-                    for key, _ in stale:
-                        # a stale reply is still a LIVE worker: reset its
-                        # failure count (the pre-quorum code treated every ok
-                        # reply as liveness evidence)
-                        tracker.record_ok(key)
-                        # replica mismatch (restart, missed window): full
-                        # broadcast on the retry — the correctness fallback
-                        bcast.note_stale(key)
-                        self.metrics.counter(metrics_mod.SYNC_STALE).increment()
-                        trace_mod.event(trace_mod.EVENT_BCAST_STALE,
-                                        worker=f"{key[0]}:{key[1]}")
-                        self.log.warning(
-                            "worker %s:%d replica stale at v%d; falling back to "
-                            "full broadcast", key[0], key[1], bcast.version)
-                    if not satisfied:
-                        if failed:
-                            for key, code in failed:
-                                n, evict = tracker.record_failure(key)
-                                if not evict:
+                            if failed:
+                                for key, code in failed:
+                                    n, evict = tracker.record_failure(key)
+                                    if not evict:
+                                        self.log.warning(
+                                            "worker %s:%d failed Gradient (%s); retry %d/%d",
+                                            key[0], key[1], code, n, grad_retries)
+                                        continue
+                                    if on_worker_death == "fail":
+                                        # abort WITHOUT mutating membership: the caller
+                                        # chose to investigate, not to continue degraded
+                                        raise RuntimeError(
+                                            f"worker {key[0]}:{key[1]} died mid-fit "
+                                            f"({n} consecutive Gradient failures: {code})")
                                     self.log.warning(
-                                        "worker %s:%d failed Gradient (%s); retry %d/%d",
-                                        key[0], key[1], code, n, grad_retries)
-                                    continue
-                                if on_worker_death == "fail":
-                                    # abort WITHOUT mutating membership: the caller
-                                    # chose to investigate, not to continue degraded
-                                    raise RuntimeError(
-                                        f"worker {key[0]}:{key[1]} died mid-fit "
-                                        f"({n} consecutive Gradient failures: {code})")
-                                self.log.warning(
-                                    "worker %s:%d failed Gradient %d times (%s); declaring dead",
-                                    key[0], key[1], n, code)
-                                self.unregister_worker(*key, evicted=True)
-                        if failed or stale:
-                            wspan.set(retry=True)
-                            continue  # retry this window (survivors or re-split)
-                    # allocation-free fan-in: scatter/add every reply into the
-                    # preallocated accumulator, then scale once — replaces the
-                    # per-window [decode_grad(r) for r in ok] dense stack +
-                    # np.mean (Vec.mean, Master.scala:194).  The full barrier
-                    # already decoded per arrival (send-ordered, so the sums
-                    # are bit-identical — see _ArrivalDecoder); the quorum
-                    # path decodes here, once the contributor set is known:
-                    # under a satisfied quorum `replies` holds the actual
-                    # contributors (own + hedge replies) and the mean over
-                    # |contributors| is the unbiased 1/|ok| scaling of Chen
-                    # et al. 2016's backup-worker rule.
-                    if decoder is None or decoder.decoded != len(replies):
-                        grad_acc.fill(0.0)
-                        for reply in replies:
-                            codec.decode_grad_into(reply, grad_acc)
-                    grad_acc /= len(replies)  # true divide, bit-matching np.mean
-                    if health is not None:
-                        # NaN/Inf sentinel: a non-finite fan-in NEVER
-                        # reaches the weights, whatever the action — the
-                        # snapshot carries the last GOOD state, cursor
-                        # pointing at this window
-                        if health.observe_round(
-                                float(np.linalg.norm(grad_acc)),
-                                staleness_s=time.perf_counter() - t_batch):
-                            wspan.set(health_tripped=True)
-                            if health.action in ("snapshot", "halt"):
-                                _health_snapshot(
-                                    epoch, batch, rng.bit_generator.state, w)
-                            if health.action == "halt":
-                                halted = True
-                                break
-                            # warn/snapshot: drop the poisoned round and
-                            # continue on the last finite weights (the
-                            # verdict is NOT latched — every later
-                            # non-finite round is dropped too)
-                            self.log.error(
-                                "dropping non-finite fan-in at epoch %d "
-                                "window %d (health action %s)",
-                                epoch, int(batch), health.action)
-                            batch += window_span
-                            continue
-                    w_old = w
-                    if local_steps > 1:
-                        # replies are summed weight-space decrements; apply the
-                        # mean as a pseudo-gradient through the same optimizer
-                        # surface (error-feedback discipline of local SGD)
-                        if opt is None:
-                            w = w - grad_acc
+                                        "worker %s:%d failed Gradient %d times (%s); declaring dead",
+                                        key[0], key[1], n, code)
+                                    self.unregister_worker(*key, evicted=True)
+                            if failed or stale:
+                                wspan.set(retry=True)
+                                continue  # retry this window (survivors or re-split)
+                        # allocation-free fan-in: scatter/add every reply into the
+                        # preallocated accumulator, then scale once — replaces the
+                        # per-window [decode_grad(r) for r in ok] dense stack +
+                        # np.mean (Vec.mean, Master.scala:194).  The full barrier
+                        # already decoded per arrival (send-ordered, so the sums
+                        # are bit-identical — see _ArrivalDecoder); the quorum
+                        # path decodes here, once the contributor set is known:
+                        # under a satisfied quorum `replies` holds the actual
+                        # contributors (own + hedge replies) and the mean over
+                        # |contributors| is the unbiased 1/|ok| scaling of Chen
+                        # et al. 2016's backup-worker rule.
+                        if decoder is None or decoder.decoded != len(replies):
+                            grad_acc.fill(0.0)
+                            for reply in replies:
+                                codec.decode_grad_into(reply, grad_acc)
+                        grad_acc /= len(replies)  # true divide, bit-matching np.mean
+                        if health is not None:
+                            # NaN/Inf sentinel: a non-finite fan-in NEVER
+                            # reaches the weights, whatever the action — the
+                            # snapshot carries the last GOOD state, cursor
+                            # pointing at this window
+                            if health.observe_round(
+                                    float(np.linalg.norm(grad_acc)),
+                                    staleness_s=time.perf_counter() - t_batch):
+                                wspan.set(health_tripped=True)
+                                if health.action in ("snapshot", "halt"):
+                                    _health_snapshot(
+                                        epoch, batch, rng.bit_generator.state, w)
+                                if health.action == "halt":
+                                    halted = True
+                                    break
+                                # warn/snapshot: drop the poisoned round and
+                                # continue on the last finite weights (the
+                                # verdict is NOT latched — every later
+                                # non-finite round is dropped too)
+                                self.log.error(
+                                    "dropping non-finite fan-in at epoch %d "
+                                    "window %d (health action %s)",
+                                    epoch, int(batch), health.action)
+                                batch += window_span
+                                continue
+                        w_old = w
+                        if local_steps > 1:
+                            # replies are summed weight-space decrements; apply the
+                            # mean as a pseudo-gradient through the same optimizer
+                            # surface (error-feedback discipline of local SGD)
+                            if opt is None:
+                                w = w - grad_acc
+                            else:
+                                w_j, opt_state = _opt_step(
+                                    jnp.asarray(w), opt_state,
+                                    jnp.asarray(grad_acc) / learning_rate)
+                                w = np.asarray(w_j)
+                        elif opt is None:
+                            w = w - learning_rate * grad_acc  # Master.scala:197
                         else:
                             w_j, opt_state = _opt_step(
-                                jnp.asarray(w), opt_state,
-                                jnp.asarray(grad_acc) / learning_rate)
+                                jnp.asarray(w), opt_state, jnp.asarray(grad_acc))
                             w = np.asarray(w_j)
-                    elif opt is None:
-                        w = w - learning_rate * grad_acc  # Master.scala:197
-                    else:
-                        w_j, opt_state = _opt_step(
-                            jnp.asarray(w), opt_state, jnp.asarray(grad_acc))
-                        w = np.asarray(w_j)
-                    bcast.advance(w, w_old)
-                    self.metrics.histogram("master.sync.batch.duration").record(
-                        time.perf_counter() - t_batch)
-                    batch += window_span
-                    rounds_since_save += 1
-                    if (fit_state_path and fit_state_every
-                            and rounds_since_save >= fit_state_every):
-                        # window-cadence crash snapshot: the cursor points
-                        # PAST the just-applied window, and the RNG state is
-                        # exactly what the next window will draw from
-                        save_fit_state(
-                            fit_state_path, weights=w, epoch=epoch,
-                            batch=batch, rng_state=rng.bit_generator.state,
-                            test_losses_nf=test_newest_first,
-                            opt_kind=opt_kind,
-                            opt_leaves=jax.tree_util.tree_leaves(opt_state)
-                            if opt_state is not None else [],
-                            bcast_version=bcast.version,
-                            fit_tokens=fit_tokens)
-                        rounds_since_save = 0
-            if halted:
-                self.log.error(
-                    "fit halted by the training-health watchdog (%s) at "
-                    "epoch %d window %d", health.trip_reason, epoch,
-                    int(batch))
-                break
-            epoch_s = time.perf_counter() - t0
-
-            loss, acc = self.local_loss(w)
-            test_loss, test_acc = self.local_loss(w, test=True)
-            record_epoch(result, test_newest_first, epoch,
-                         loss, acc, test_loss, test_acc, epoch_s)
-            self.metrics.histogram("master.sync.loss").record(loss)
-            self.metrics.histogram("master.sync.acc").record(100 * acc)
-            self.metrics.histogram("master.sync.epoch.seconds").record(epoch_s)
-            self.log.info(
-                "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
-                epoch, loss, acc, test_loss, test_acc, epoch_s,
-            )
-            if health is not None and health.observe_loss(loss):
-                # loss-trend watchdog (EWMA divergence / non-finite loss):
-                # the monitor already dumped the flight ring; snapshot at
-                # the epoch boundary (next epoch's cursor, fresh per-epoch
-                # stream — the same shape as the terminal snapshot below)
-                if health.action in ("snapshot", "halt"):
-                    _health_snapshot(
-                        epoch + 1, 0,
-                        np.random.default_rng(
-                            (self.seed, epoch + 1)).bit_generator.state, w)
-                if health.action == "halt":
+                        bcast.advance(w, w_old)
+                        self.metrics.histogram("master.sync.batch.duration").record(
+                            time.perf_counter() - t_batch)
+                        batch += window_span
+                        rounds_since_save += 1
+                        if (fit_state_path and fit_state_every
+                                and rounds_since_save >= fit_state_every):
+                            # window-cadence crash snapshot: the cursor points
+                            # PAST the just-applied window, and the RNG state is
+                            # exactly what the next window will draw from
+                            save_fit_state(
+                                fit_state_path, weights=w, epoch=epoch,
+                                batch=batch, rng_state=rng.bit_generator.state,
+                                test_losses_nf=test_newest_first,
+                                opt_kind=opt_kind,
+                                opt_leaves=jax.tree_util.tree_leaves(opt_state)
+                                if opt_state is not None else [],
+                                bcast_version=bcast.version,
+                                fit_tokens=fit_tokens)
+                            rounds_since_save = 0
+                if halted:
                     self.log.error(
-                        "fit halted by the training-health watchdog (%s) "
-                        "after epoch %d", health.trip_reason, epoch)
-                    halted = True
+                        "fit halted by the training-health watchdog (%s) at "
+                        "epoch %d window %d", health.trip_reason, epoch,
+                        int(batch))
                     break
-            if checkpointer is not None and (epoch + 1) % checkpoint_every == 0:
-                save_sync_fit(
-                    checkpointer, epoch + 1, w, test_newest_first, opt_kind,
-                    jax.tree_util.tree_leaves(opt_state)
-                    if opt_state is not None else [])
-            if criterion is not None and criterion(test_newest_first):
-                self.log.info("Converged to target: stopping computation")
-                stopped_early = True
-                break
+                epoch_s = time.perf_counter() - t0
+
+                loss, acc = self.local_loss(w)
+                test_loss, test_acc = self.local_loss(w, test=True)
+                record_epoch(result, test_newest_first, epoch,
+                             loss, acc, test_loss, test_acc, epoch_s)
+                self.metrics.histogram("master.sync.loss").record(loss)
+                self.metrics.histogram("master.sync.acc").record(100 * acc)
+                self.metrics.histogram("master.sync.epoch.seconds").record(epoch_s)
+                self.log.info(
+                    "epoch %d: loss=%.6f acc=%.4f test_loss=%.6f test_acc=%.4f (%.2fs)",
+                    epoch, loss, acc, test_loss, test_acc, epoch_s,
+                )
+                if health is not None and health.observe_loss(loss):
+                    # loss-trend watchdog (EWMA divergence / non-finite loss):
+                    # the monitor already dumped the flight ring; snapshot at
+                    # the epoch boundary (next epoch's cursor, fresh per-epoch
+                    # stream — the same shape as the terminal snapshot below)
+                    if health.action in ("snapshot", "halt"):
+                        _health_snapshot(
+                            epoch + 1, 0,
+                            np.random.default_rng(
+                                (self.seed, epoch + 1)).bit_generator.state, w)
+                    if health.action == "halt":
+                        self.log.error(
+                            "fit halted by the training-health watchdog (%s) "
+                            "after epoch %d", health.trip_reason, epoch)
+                        halted = True
+                        break
+                if checkpointer is not None and (epoch + 1) % checkpoint_every == 0:
+                    save_sync_fit(
+                        checkpointer, epoch + 1, w, test_newest_first, opt_kind,
+                        jax.tree_util.tree_leaves(opt_state)
+                        if opt_state is not None else [])
+                if criterion is not None and criterion(test_newest_first):
+                    self.log.info("Converged to target: stopping computation")
+                    stopped_early = True
+                    break
+        finally:
+            if use_stream:
+                self._close_streams()
 
         save_sync_fit_final(
             checkpointer, result.epochs_run, start_epoch, checkpoint_every,

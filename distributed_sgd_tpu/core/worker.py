@@ -1182,6 +1182,13 @@ class _WorkerServicer:
         return pb.ForwardReply(predictions=preds)
 
     def Gradient(self, request, context):  # noqa: N802
+        return self._gradient_update(request)
+
+    def _gradient_update(self, request):
+        """One sync-window Gradient body, shared verbatim by the unary
+        Gradient RPC and the FitStream servicer loop below — streaming
+        changes the transport, never the math (the stream-vs-unary
+        bit-identity the rpc bench gates on falls out of this sharing)."""
         # quorum contribution mask: the master marks the window whose
         # reply it discarded so the EF residual drain rolls back first
         if request.ef_rollback_version:
@@ -1242,6 +1249,44 @@ class _WorkerServicer:
         if k > 1:
             msg.n_steps = k  # wire accounting: steps amortized per round
         return msg
+
+    def FitStream(self, request_iterator, context):  # noqa: N802
+        """Streaming sync fan-out (DSGD_STREAM, docs/SYNC_PIPELINE.md):
+        one persistent bidi stream per master carrying framed
+        GradientRequests for the lifetime of a fit; each frame runs the
+        EXACT unary Gradient body and answers on the stream under the
+        request's seq.  Teardown — the master closing, a transport reset,
+        or an exception out of the body (e.g. the foreign-id refusal) —
+        ends the generator, which the master's stream client treats like
+        a failed unary call: in-flight windows replay over unary, the
+        re-register path is untouched, and an elastic resplit simply
+        re-opens the stream (rpc/stream.py)."""
+        m = self.w.metrics
+        m.counter(metrics_mod.SLAVE_STREAM_OPENED).increment()
+        self.w.log.info("FitStream opened by %s", context.peer())
+        try:
+            for frame in request_iterator:
+                if frame.WhichOneof("payload") != "request":
+                    continue  # future-proofing: unknown arms are skipped
+                m.counter(metrics_mod.SLAVE_STREAM_FRAMES).increment()
+                update = self._gradient_update(frame.request)
+                yield pb.Frame(seq=frame.seq, fit_token=frame.fit_token,
+                               update=update)
+        except grpc.RpcError:
+            # the CLIENT tore the stream down (master closed at fit end,
+            # cancelled, or the connection reset) — there is nobody left
+            # to answer; end quietly, this is the normal lifecycle
+            self.w.log.info("FitStream closed by peer")
+        except Exception as e:  # noqa: BLE001 - surface, then tear down
+            # a per-frame failure has no error arm on the stream: tearing
+            # the stream down IS the classified failure (the master falls
+            # back to unary, where the same request fails loudly per-call)
+            self.w.log.warning("FitStream servicer loop failed: %r", e)
+            flight.record("stream.servicer.error", worker=self.w.node_label,
+                          error=repr(e))
+            raise
+        finally:
+            m.counter(metrics_mod.SLAVE_STREAM_CLOSED).increment()
 
     def StartAsync(self, request, context):  # noqa: N802
         self.w.start_async(

@@ -156,13 +156,14 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
         log.warning(
             "DSGD_COMPRESS=%s ignored: in-mesh engines have no wire path "
             "(use engine=rpc or async_mode=gossip)", cfg.compress)
-    if cfg.local_steps > 1 or cfg.delta_broadcast:
+    if cfg.local_steps > 1 or cfg.delta_broadcast or cfg.stream:
         # the pipelined sync levers shape RPC wire traffic; the mesh
         # engines exchange gradients through XLA collectives
         log.warning(
-            "DSGD_LOCAL_STEPS/DSGD_DELTA_BROADCAST ignored: the pipelined "
-            "sync engine is the rpc topology's (use engine=rpc; the mesh "
-            "local-SGD equivalent is async_mode=local_sgd / sync_period)")
+            "DSGD_LOCAL_STEPS/DSGD_DELTA_BROADCAST/DSGD_STREAM ignored: "
+            "the pipelined sync engine is the rpc topology's (use "
+            "engine=rpc; the mesh local-SGD equivalent is "
+            "async_mode=local_sgd / sync_period)")
     if cfg.quorum is not None or cfg.chaos:
         # quorum barriers gate RPC fan-ins and chaos wraps RPC stubs; an
         # in-mesh XLA collective has neither
@@ -356,6 +357,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 optimizer=cfg.optimizer, momentum=cfg.momentum,
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
+                stream=cfg.stream,
                 quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
                 health=_health_monitor(cfg, metrics=c.master.metrics),
                 **_fit_state_args(cfg),
@@ -718,6 +720,7 @@ def _run_role(cfg: Config, role: str) -> None:
                     optimizer=cfg.optimizer, momentum=cfg.momentum,
                     local_steps=cfg.local_steps,
                     delta_broadcast=cfg.delta_broadcast,
+                    stream=cfg.stream,
                     quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
                     health=_health_monitor(cfg, metrics=master.metrics),
                     **_fit_state_args(cfg),

@@ -262,6 +262,16 @@ _WORKER_METHODS = {
     "Metrics": (pb.Empty, pb.MetricsSnapshot),
 }
 
+# Bidirectional streaming surface (DSGD_STREAM, docs/SYNC_PIPELINE.md):
+# registered with stream_stream handlers/multicallables instead of the
+# unary tables above.  FitStream is in _OPTIONAL_METHODS — an older worker
+# binary registers no handler, callers get UNIMPLEMENTED, and the master's
+# stream client falls back to the unary Gradient for that worker
+# (rpc/stream.py), so mixed fleets keep working across the skew.
+_WORKER_STREAM_METHODS = {
+    "FitStream": (pb.Frame, pb.Frame),
+}
+
 # The inference front end (serving/): no reference counterpart — the
 # reference's only inference surface is the in-fit Forward above.  The
 # router (serving/router.py) speaks the SAME service, so a client cannot
@@ -280,7 +290,7 @@ _SERVE_METHODS = {
 # Methods a servicer may legitimately lack (older binaries, partial test
 # stubs): absent -> no handler -> UNIMPLEMENTED to callers.  Everything
 # else is required and fails server construction when missing.
-_OPTIONAL_METHODS = frozenset({"Metrics", "PushWeights"})
+_OPTIONAL_METHODS = frozenset({"Metrics", "PushWeights", "FitStream"})
 
 
 def _traced_handler(fn, method: str, node: Optional[str]):
@@ -306,7 +316,8 @@ def _traced_handler(fn, method: str, node: Optional[str]):
 
 
 def _add_servicer(server, servicer, service_name: str, methods: dict,
-                  node: Optional[str] = None) -> None:
+                  node: Optional[str] = None,
+                  stream_methods: Optional[dict] = None) -> None:
     handlers = {}
     for name, (req, resp) in methods.items():
         if name in _OPTIONAL_METHODS and not hasattr(servicer, name):
@@ -321,6 +332,18 @@ def _add_servicer(server, servicer, service_name: str, methods: dict,
         handlers[name] = grpc.unary_unary_rpc_method_handler(
             fn, request_deserializer=req.FromString, response_serializer=resp.SerializeToString
         )
+    for name, (req, resp) in (stream_methods or {}).items():
+        if name in _OPTIONAL_METHODS and not hasattr(servicer, name):
+            continue  # same skew rule as above: absent -> UNIMPLEMENTED
+        # bidi streams skip the per-call trace hook: the handler runs once
+        # per STREAM, not per frame, so a per-call server span would pin
+        # one span open for the whole fit (per-round attribution stays on
+        # the master's sync.window root spans)
+        handlers[name] = grpc.stream_stream_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req.FromString,
+            response_serializer=resp.SerializeToString,
+        )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(service_name, handlers),)
     )
@@ -331,7 +354,8 @@ def add_master_servicer(server, servicer, node: Optional[str] = None) -> None:
 
 
 def add_worker_servicer(server, servicer, node: Optional[str] = None) -> None:
-    _add_servicer(server, servicer, "dsgd.Worker", _WORKER_METHODS, node=node)
+    _add_servicer(server, servicer, "dsgd.Worker", _WORKER_METHODS, node=node,
+                  stream_methods=_WORKER_STREAM_METHODS)
 
 
 def add_serve_servicer(server, servicer, node: Optional[str] = None) -> None:
@@ -411,11 +435,13 @@ class _TracingCallable:
 
 
 class _Stub:
-    def __init__(self, channel, service_name: str, methods: dict):
+    def __init__(self, channel, service_name: str, methods: dict,
+                 stream_methods: Optional[dict] = None):
         # channel factories stamp their endpoint on the channel
         # (new_channel below) so client spans can name their peer
         target = getattr(channel, "dsgd_target", None)
         peer = f"{target[0]}:{target[1]}" if target else None
+        self.dsgd_peer = peer
         for name, (req, resp) in methods.items():
             setattr(
                 self,
@@ -430,6 +456,19 @@ class _Stub:
                     peer,
                 ),
             )
+        for name, (req, resp) in (stream_methods or {}).items():
+            # bidi multicallable, untraced (one call per STREAM — per-frame
+            # spans would cost per-round allocation on the hot path; the
+            # master's sync.window root spans keep round attribution)
+            setattr(
+                self,
+                name,
+                channel.stream_stream(
+                    f"/{service_name}/{name}",
+                    request_serializer=req.SerializeToString,
+                    response_deserializer=resp.FromString,
+                ),
+            )
 
 
 class MasterStub(_Stub):
@@ -439,7 +478,8 @@ class MasterStub(_Stub):
 
 class WorkerStub(_Stub):
     def __init__(self, channel):
-        super().__init__(channel, "dsgd.Worker", _WORKER_METHODS)
+        super().__init__(channel, "dsgd.Worker", _WORKER_METHODS,
+                         stream_methods=_WORKER_STREAM_METHODS)
 
 
 class ServeStub(_Stub):

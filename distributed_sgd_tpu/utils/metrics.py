@@ -380,6 +380,28 @@ def record_broadcast(metrics: "Metrics", form: str, n_bytes: int) -> None:
     metrics.counter(f"master.sync.bcast.{form}").increment()
 
 
+# -- streaming fan-out (DSGD_STREAM; docs/SYNC_PIPELINE.md) -------------------
+#
+# Transport instruments for the persistent per-worker gradient streams
+# (rpc/stream.py + core/worker.py FitStream).  `sends` counts frames
+# written; `expired` frames whose reply missed the per-frame deadline
+# (the stream stays open — a lost frame is not a dead peer); `late`
+# replies dropped idempotently by seq after an expiry or a chaos dup;
+# `broken` stream teardowns (each feeds the per-peer breaker);
+# `fallback` windows transparently replayed over unary after a teardown.
+# With DSGD_STREAM unset none of these ever moves (knobs-off zero-stream
+# asserted by tests/test_stream.py).
+STREAM_OPENED = "master.sync.stream.opened"      # counter: streams opened
+STREAM_SENDS = "master.sync.stream.sends"        # counter: request frames written
+STREAM_EXPIRED = "master.sync.stream.expired"    # counter: frame deadline misses
+STREAM_LATE = "master.sync.stream.late"          # counter: late/dup replies dropped
+STREAM_BROKEN = "master.sync.stream.broken"      # counter: stream teardowns
+STREAM_FALLBACK = "master.sync.stream.fallback"  # counter: windows replayed unary
+SLAVE_STREAM_OPENED = "slave.stream.opened"      # counter: streams accepted
+SLAVE_STREAM_CLOSED = "slave.stream.closed"      # counter: streams torn down
+SLAVE_STREAM_FRAMES = "slave.stream.frames"      # counter: request frames served
+
+
 # -- quorum barrier / fault tolerance (docs/FAULT_TOLERANCE.md) ---------------
 #
 # Master-side instruments for the quorum sync barrier (DSGD_QUORUM), the

@@ -229,6 +229,124 @@ def test_sync_quorum_survives_sigstopped_worker_process(tmp_path):
 
 
 @pytest.mark.slow
+def test_stream_sync_fit_survives_sigkilled_worker_process(tmp_path):
+    """Half-open-stream detection (docs/SYNC_PIPELINE.md "Streaming
+    transport"): with DSGD_STREAM=1 a REAL worker process is SIGKILLed
+    mid-sync-fit — no unregister, no graceful stream close; the OS reaps
+    it and the master's persistent FitStream to it is suddenly talking
+    to nobody.  The stream teardown (or the pending frame's deadline)
+    surfaces as a classified per-window failure, the unary fallback
+    fails the same way, the heartbeat + Gradient-failure tracker declare
+    the worker dead within the heartbeat budget, and the fit re-splits
+    and completes on the survivor — over ITS still-open stream."""
+    import threading
+
+    extra = {
+        "DSGD_STREAM": "1",
+        "DSGD_HEARTBEAT_S": "0.2",
+        # the kill must land MID-fit: epochs sized so the surviving
+        # window budget dwarfs startup + log-pump latency
+        "DSGD_MAX_EPOCHS": "150",
+        "DSGD_BATCH_SIZE": "4",
+        "DSGD_PATIENCE": "50",  # no early stop: the kill must land mid-fit
+        "DSGD_CONV_DELTA": "0",
+    }
+    master_port, *worker_ports = _free_ports(3)
+    cmd = [sys.executable, "-m", "distributed_sgd_tpu.main"]
+    procs = []
+    worker_logs = [tmp_path / f"worker{i}.log" for i in range(2)]
+    lines: list = []
+    try:
+        with contextlib.ExitStack() as stack:
+            master = subprocess.Popen(
+                cmd, env=_env(master_port, master_port, extra),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            )
+            procs.append(master)
+            for port, logf in zip(worker_ports, worker_logs):
+                w = subprocess.Popen(
+                    cmd, env=_env(port, master_port, extra),
+                    stdout=stack.enter_context(open(logf, "w")),
+                    stderr=subprocess.STDOUT,
+                )
+                procs.append(w)
+
+            def pump():
+                for ln in master.stdout:
+                    lines.append(ln)
+
+            reader = threading.Thread(target=pump, daemon=True)
+            reader.start()
+
+            def saw(needle):
+                return any(needle in ln for ln in lines)
+
+            def diag():
+                tails = "\n".join(
+                    f"== {f.name}:\n{f.read_text()[-1200:]}" for f in worker_logs
+                    if f.exists())
+                return f"{''.join(lines)[-3000:]}\n{tails}"
+
+            deadline = time.time() + 300
+            while time.time() < deadline and not saw("epoch 0:"):
+                if master.poll() is not None:
+                    raise AssertionError(f"master exited early:\n{diag()}")
+                time.sleep(0.1)
+            assert saw("epoch 0:"), f"fit never streamed an epoch:\n{diag()}"
+
+            procs[1].send_signal(signal.SIGKILL)  # hard-kill worker 0
+            t_kill = time.time()
+
+            # eviction must land within the heartbeat budget (0.2 s x 3
+            # misses) plus the Gradient retry window — whichever detector
+            # wins the race logs "declared dead" (heartbeat) or
+            # "declaring dead" (consecutive Gradient failures after the
+            # stream broke and its unary fallback failed too).  A
+            # generous bound for a loaded box, but minutes would mean the
+            # half-open stream wedged the barrier.
+            def dead():
+                return saw("declared dead") or saw("declaring dead")
+
+            while time.time() - t_kill < 60 and not dead():
+                if master.poll() is not None:
+                    break
+                time.sleep(0.2)
+            assert dead(), (
+                f"SIGKILLed worker's half-open stream was never detected "
+                f"within the heartbeat budget:\n{diag()}")
+            deadline = time.time() + 30
+            while time.time() < deadline and not saw("re-split"):
+                if master.poll() is not None:
+                    break
+                time.sleep(0.2)
+            assert saw("re-split"), diag()
+
+            try:
+                master.wait(timeout=300)
+            except subprocess.TimeoutExpired:
+                master.kill()
+                raise AssertionError(
+                    f"master wedged after the worker kill:\n{diag()}")
+            reader.join(timeout=10)
+            out = "".join(lines)
+            assert master.returncode == 0, diag()
+            # the survivor carried the fit to its end — budget or early
+            # convergence, but never a wedge
+            assert "fit done:" in out, diag()
+    finally:
+        deadline = time.time() + 10
+        for p in procs[1:]:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+@pytest.mark.slow
 def test_async_fit_survives_sigkilled_worker_process(tmp_path):
     """The gold-standard async fault proof: a REAL worker process is
     SIGKILLed mid-fit (no unregister, no TCP FIN courtesy — the OS just

@@ -244,3 +244,27 @@ def test_spinup_latency_class_band():
     ok, _ = regress.check(
         {"metric": "spinup", "warm_spinup_s": 0.33}, hist, tolerance=0.35)
     assert ok == []  # +37%: host jitter stays inside the band
+
+
+def test_rounds_per_s_is_a_throughput_class_not_a_timing():
+    """Round throughput (`*_rounds_per_s`, the rpc-bench streaming rows)
+    ends in `_s`, which the naive lower-is-better timing rule would gate
+    BACKWARDS: a throughput collapse would read as an improvement and a
+    gain as a regression.  The `_per_s` direction resolves first (gates
+    UP) and the explicit class entry pins the pairing."""
+    assert regress.direction("stream_rounds_per_s") == "up"
+    assert regress.direction("unary_rounds_per_s") == "up"
+    assert regress.tolerance_for("stream_rounds_per_s") == 0.35
+    hist = [{"metric": "rpc_sync_pipeline_smoke",
+             "stream_rounds_per_s": 260.0}] * 3
+    # a collapse to half the median regresses...
+    regs, lines = regress.check(
+        {"metric": "rpc_sync_pipeline_smoke", "stream_rounds_per_s": 130.0},
+        hist, tolerance=0.35)
+    assert regs == ["stream_rounds_per_s"]
+    assert any("[up," in ln for ln in lines)
+    # ...and a faster run can NEVER regress (the backwards-gating trap)
+    ok, _ = regress.check(
+        {"metric": "rpc_sync_pipeline_smoke", "stream_rounds_per_s": 990.0},
+        hist, tolerance=0.35)
+    assert ok == []

@@ -1,0 +1,485 @@
+"""Streaming RPC fan-out (DSGD_STREAM, docs/SYNC_PIPELINE.md "Streaming
+transport"): persistent per-worker gradient streams with pre-staged
+round dispatch.
+
+Correctness story under test: the knobs-off wire is byte-identical and
+never touches a stream; the streamed fit is BIT-identical to the unary
+fit (same messages, same send-ordered decode); a mid-fit worker death
+resplits and the survivors' streams keep carrying windows; a joining
+worker's stream opens with its new assignment; an UNIMPLEMENTED peer
+(older binary) transparently degrades to unary without burning a retry;
+and the client's fault ladder (frame deadline != stream teardown,
+teardown -> unary fallback, late replies dropped by seq) behaves at the
+unit level, chaos stream writes included.
+"""
+
+import queue
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core import worker as worker_mod
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.stream import FitStreamClient, StreamRpcError
+from distributed_sgd_tpu.utils import metrics as mm
+
+STREAM_COUNTERS = (
+    mm.STREAM_OPENED, mm.STREAM_SENDS, mm.STREAM_EXPIRED, mm.STREAM_LATE,
+    mm.STREAM_BROKEN, mm.STREAM_FALLBACK,
+    mm.SLAVE_STREAM_OPENED, mm.SLAVE_STREAM_CLOSED, mm.SLAVE_STREAM_FRAMES,
+)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(
+        rcv1_like(320, n_features=256, nnz=8, noise=0.0, seed=41,
+                  idf_values=True))
+
+
+@pytest.fixture(scope="module")
+def model_fn(data):
+    train, _ = data
+    ds = dim_sparsity(train)
+    return lambda: make_model("hinge", 1e-5, train.n_features,
+                              dim_sparsity=ds)
+
+
+def _counters():
+    g = mm.global_metrics()
+    return {n: g.counter(n).value for n in STREAM_COUNTERS}
+
+
+def _fit(cluster, **kw):
+    kw.setdefault("max_epochs", 2)
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("learning_rate", 0.5)
+    return cluster.master.fit_sync(**kw)
+
+
+# -- knobs-off identity -------------------------------------------------------
+
+
+def test_knobs_off_never_opens_a_stream_and_wire_is_byte_identical(
+        data, model_fn):
+    """Default-config identity: with stream off, NO FitStream is ever
+    opened (client or servicer side, asserted by counters + the empty
+    stream table + a servicer spy), and every Gradient request is the
+    exact pre-PR unary wire — re-serializing just its populated fields
+    reproduces its bytes, so nothing new rides the wire (Frame is a
+    separate message; unset proto3 fields serialize to nothing)."""
+    train, test = data
+    before = _counters()
+    seen_bytes = []
+    stream_served = []
+    orig_fs = worker_mod._WorkerServicer.FitStream
+
+    def spy_fs(self, it, ctx):  # pragma: no cover - must never run
+        stream_served.append(True)
+        return orig_fs(self, it, ctx)
+
+    worker_mod._WorkerServicer.FitStream = spy_fs
+    try:
+        with DevCluster(model_fn(), train, test, n_workers=2) as c:
+            for w in c.workers:
+                orig = w.resolve_request_weights
+
+                def spy(request, _orig=orig):
+                    seen_bytes.append(request.SerializeToString())
+                    return _orig(request)
+
+                w.resolve_request_weights = spy
+            _fit(c, max_epochs=1)
+            assert c.master._streams == {}
+    finally:
+        worker_mod._WorkerServicer.FitStream = orig_fs
+    after = _counters()
+    assert after == before, "a knobs-off fit moved a stream instrument"
+    assert not stream_served, "a knobs-off fit reached the FitStream servicer"
+    assert seen_bytes, "no Gradient request observed"
+    for raw in seen_bytes:
+        req = pb.GradientRequest.FromString(raw)
+        expected = pb.GradientRequest(
+            weights=req.weights, samples=req.samples,
+            fit_token=req.fit_token)
+        assert expected.SerializeToString() == raw, (
+            "knobs-off request carries fields beyond the pre-stream wire")
+
+
+# -- streamed fit == unary fit ------------------------------------------------
+
+
+def test_stream_fit_is_bit_identical_to_unary(data, model_fn):
+    """The framed messages ARE the unary messages and decode stays
+    send-ordered, so the streamed fit's weights equal the unary fit's
+    bit-for-bit — the invariant the rpc bench gates as drift 0.0."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        unary = _fit(c)
+    before = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        streamed = _fit(c, stream=True)
+        assert c.master._streams == {}, "streams must close with the fit"
+    sent = {n: v - before[n] for n, v in _counters().items()}
+    assert np.array_equal(np.asarray(unary.state.weights),
+                          np.asarray(streamed.state.weights))
+    assert sent[mm.STREAM_OPENED] == 2  # one persistent stream per worker
+    assert sent[mm.STREAM_SENDS] > 0
+    assert sent[mm.STREAM_SENDS] == sent[mm.SLAVE_STREAM_FRAMES]
+    assert sent[mm.STREAM_FALLBACK] == 0
+    assert sent[mm.STREAM_BROKEN] == 0
+
+
+def test_stream_quorum_hedges_stay_unary(data, model_fn):
+    """Hedge requests target a DIFFERENT worker than the stream's owner
+    and stay unary by design — every quorum fire re-proves interop.  A
+    quorum+stream fit completes with zero evictions."""
+    train, test = data
+    before = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=3) as c:
+        res = _fit(c, max_epochs=2, quorum=2, straggler_soft_s=0.25,
+                   stream=True)
+        assert len(c.master._workers) == 3
+    sent = {n: v - before[n] for n, v in _counters().items()}
+    assert res.epochs_run == 2
+    # hedges never ride the stream: frames served == frames sent, and
+    # any hedge the soft deadline fired went through unary Gradient
+    assert sent[mm.STREAM_SENDS] == sent[mm.SLAVE_STREAM_FRAMES]
+
+
+# -- lifecycle: death, resplit, join ------------------------------------------
+
+
+def test_stream_survives_mid_fit_death_resplit_and_join(data, model_fn):
+    """A worker dies mid-fit: its stream tears down, the window replays
+    over unary, the classic retry/evict path resplits across survivors —
+    whose streams keep carrying windows untouched — and a NEW worker
+    joining mid-fit gets its own stream opened with its new assignment
+    (the elastic re-open path)."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=3) as c:
+        gone = c.workers[0]
+        first_call = threading.Event()
+        orig = gone.resolve_request_weights
+
+        def traced(request):
+            first_call.set()
+            return orig(request)
+
+        gone.resolve_request_weights = traced
+        box = {}
+
+        def run():
+            try:
+                box["result"] = _fit(c, max_epochs=6, grad_timeout_s=5.0,
+                                     stream=True)
+            except Exception as e:  # noqa: BLE001 - surfaced to the test
+                box["error"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert first_call.wait(30), "fit never reached a worker"
+        sends_at_kill = _counters()[mm.STREAM_SENDS]
+        gone._stopped.set()
+        gone.server.stop(grace=0)
+        # survivors absorb the resplit; a fresh worker joins the freed
+        # slot mid-fit and must get its own stream + slice
+        deadline = time.monotonic() + 60
+        while len(c.master._workers) > 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert len(c.master._workers) == 2, "dead worker never evicted"
+        opened_at_join = _counters()[mm.STREAM_OPENED]
+        joined = c.add_worker()
+        joined_requests = []
+        orig_join = joined.resolve_request_weights
+
+        def join_spy(request):
+            joined_requests.append(True)
+            return orig_join(request)
+
+        joined.resolve_request_weights = join_spy
+        t.join(timeout=180)
+        assert not t.is_alive(), "fit_sync hung after worker death"
+        assert "error" not in box, f"fit raised: {box.get('error')}"
+        res = box["result"]
+        assert res.epochs_run == 6
+        assert res.losses[-1] < res.losses[0]
+        assert len(c.master._workers) == 3  # join absorbed
+        # the joined worker really received windows on its slice, and a
+        # NEW stream opened after the join — the only candidate is the
+        # joiner (the survivors' streams are healthy and reuse the
+        # lock-free fast path, and the dead worker is out of membership)
+        assert joined_requests, "the joined worker never received a window"
+        assert _counters()[mm.STREAM_OPENED] > opened_at_join, (
+            "no stream was opened for the mid-fit joiner")
+    assert _counters()[mm.STREAM_SENDS] > sends_at_kill, (
+        "no window streamed after the death — survivors fell off the "
+        "stream transport")
+
+
+# -- version skew -------------------------------------------------------------
+
+
+def test_unimplemented_peer_falls_back_to_unary_bit_identically(
+        data, model_fn, monkeypatch):
+    """Workers whose binary predates FitStream answer UNIMPLEMENTED: the
+    master's first streamed window transparently replays over unary (no
+    retry burned, no eviction pressure), marks the peer unsupported, and
+    every later window goes straight to unary — the fit lands on the
+    unary fit's exact weights."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        unary = _fit(c)
+    monkeypatch.delattr(worker_mod._WorkerServicer, "FitStream")
+    before = _counters()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        streamed = _fit(c, stream=True)
+        # skew is per PROCESS, not per fit: a SECOND stream fit on the
+        # same master re-probes nobody (the unsupported set outlives the
+        # fit-scoped clients)
+        opened_after_first = _counters()[mm.STREAM_OPENED]
+        _fit(c, stream=True, max_epochs=1)
+        assert _counters()[mm.STREAM_OPENED] == opened_after_first, (
+            "a later fit re-probed a peer that already answered "
+            "UNIMPLEMENTED")
+    sent = {n: v - before[n] for n, v in _counters().items()}
+    assert np.array_equal(np.asarray(unary.state.weights),
+                          np.asarray(streamed.state.weights))
+    assert sent[mm.SLAVE_STREAM_FRAMES] == 0  # nobody ever served a frame
+    assert sent[mm.STREAM_OPENED] >= 2        # the master did try to stream
+    # every frame that made it onto a stream before the UNIMPLEMENTED
+    # landed MUST have replayed over unary (no reply can ever arrive);
+    # frames whose stream died first skip straight to direct unary
+    # (send() refuses) — either way nothing just times out
+    assert sent[mm.STREAM_FALLBACK] == sent[mm.STREAM_SENDS]
+    assert sent[mm.STREAM_EXPIRED] == 0
+
+
+# -- client unit tests (no cluster) -------------------------------------------
+
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, code):
+        super().__init__()
+        self._c = code
+
+    def code(self):
+        return self._c
+
+
+class _FakeStreamCall:
+    """Server side of a FitStreamClient under test: scripted replies."""
+
+    def __init__(self):
+        self.inbox = queue.Queue()    # frames the client wrote
+        self._events = queue.Queue()  # ("reply", frame) | ("raise", exc) | "end"
+        self._it = None
+
+    def __call__(self, request_iterator):
+        self._it = request_iterator
+        # drain the client's writes on a thread, like gRPC's sender
+        threading.Thread(target=self._pump, daemon=True).start()
+        return self
+
+    def _pump(self):
+        try:
+            for frame in self._it:
+                self.inbox.put(frame)
+        except Exception:  # noqa: BLE001 - iterator closed
+            pass
+
+    def reply(self, frame):
+        self._events.put(("reply", frame))
+
+    def fail(self, exc):
+        self._events.put(("raise", exc))
+
+    def end(self):
+        self._events.put("end")
+
+    def cancel(self):
+        self._events.put(("raise", _FakeRpcError(grpc.StatusCode.CANCELLED)))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ev = self._events.get()
+        if ev == "end":
+            raise StopIteration
+        kind, payload = ev
+        if kind == "raise":
+            raise payload
+        return payload
+
+
+class _FakeUnary:
+    """stub.Gradient stand-in: records requests, answers via a future."""
+
+    def __init__(self, reply=None, exc=None):
+        self.requests = []
+        self._reply = reply
+        self._exc = exc
+
+    def future(self, request, timeout=None):
+        self.requests.append((request, timeout))
+        fut = _FakeUnaryFuture(self._reply, self._exc)
+        return fut
+
+
+class _FakeUnaryFuture:
+    def __init__(self, reply, exc):
+        self._reply, self._exc = reply, exc
+
+    def result(self, timeout=None):
+        if self._exc is not None:
+            raise self._exc
+        return self._reply
+
+    def cancel(self):
+        return True
+
+    def add_done_callback(self, fn):
+        fn(self)  # settled at birth
+
+
+def _frame(tok=5):
+    f = pb.Frame()
+    f.request.fit_token = tok
+    f.request.samples.extend([1, 2])
+    return f
+
+
+def test_client_frame_deadline_expires_without_killing_the_stream():
+    """A frame with no reply settles DEADLINE_EXCEEDED at ITS deadline
+    (unary semantics: slow is the failure, no unary fallback) while the
+    stream stays open for the next window; the late reply for the
+    retired seq is dropped idempotently."""
+    call = _FakeStreamCall()
+    m = mm.Metrics()
+    client = FitStreamClient(call, peer="w0", metrics=m)
+    fut = client.send(_frame(), timeout_s=0.15,
+                      unary_call=_FakeUnary(), request=_frame().request)
+    with pytest.raises(grpc.RpcError) as ei:
+        fut.result(timeout=5)
+    assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    assert client.usable, "a lost frame must not kill the stream"
+    assert m.counter(mm.STREAM_EXPIRED).value == 1
+    assert m.counter(mm.STREAM_FALLBACK).value == 0
+    # the late reply lands after expiry: dropped by seq, counted
+    late = pb.Frame(seq=fut.seq)
+    late.update.dense.data = b"\x00\x00\x00\x00"
+    late.update.dense.size = 1
+    call.reply(late)
+    deadline = time.monotonic() + 5
+    while m.counter(mm.STREAM_LATE).value == 0 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert m.counter(mm.STREAM_LATE).value == 1
+    client.close()
+
+
+def test_client_teardown_falls_back_to_unary_and_feeds_the_breaker():
+    broke = []
+    call = _FakeStreamCall()
+    m = mm.Metrics()
+    reply = pb.GradUpdate(stale_version=True)
+    unary = _FakeUnary(reply=reply)
+    client = FitStreamClient(call, peer="w0", metrics=m,
+                             on_break=lambda: broke.append(1))
+    fut = client.send(_frame(), timeout_s=10.0, unary_call=unary,
+                      request=_frame().request)
+    call.fail(_FakeRpcError(grpc.StatusCode.UNAVAILABLE))
+    got = fut.result(timeout=5)
+    assert got.stale_version  # the unary fallback's answer came through
+    assert unary.requests and unary.requests[0][1] <= 10.0
+    assert broke == [1], "teardown must feed the per-peer breaker once"
+    assert not client.usable and client.broken and not client.unsupported
+    assert m.counter(mm.STREAM_FALLBACK).value == 1
+    assert m.counter(mm.STREAM_BROKEN).value == 1
+
+
+def test_client_unimplemented_marks_unsupported_without_breaker_pressure():
+    broke = []
+    call = _FakeStreamCall()
+    m = mm.Metrics()
+    unary = _FakeUnary(reply=pb.GradUpdate())
+    client = FitStreamClient(call, peer="w0", metrics=m,
+                             on_break=lambda: broke.append(1))
+    fut = client.send(_frame(), timeout_s=10.0, unary_call=unary,
+                      request=_frame().request)
+    call.fail(_FakeRpcError(grpc.StatusCode.UNIMPLEMENTED))
+    fut.result(timeout=5)  # unary fallback answered
+    assert client.unsupported, "skew must be sticky"
+    assert broke == [], "an old binary is not a sick one: no breaker feed"
+    assert client.send(_frame(), timeout_s=1.0) is None  # stays unary
+
+
+def test_client_local_close_settles_pending_without_unary_replay():
+    """Abandoned in-flight frames at close() (e.g. quorum stragglers at
+    fit end) settle dead — they must NOT replay over unary after the fit
+    moved on."""
+    call = _FakeStreamCall()
+    m = mm.Metrics()
+    unary = _FakeUnary(reply=pb.GradUpdate())
+    client = FitStreamClient(call, peer="w0", metrics=m)
+    fut = client.send(_frame(), timeout_s=30.0, unary_call=unary,
+                      request=_frame().request)
+    client.close()
+    with pytest.raises(Exception):
+        fut.result(timeout=5)
+    assert unary.requests == []
+    assert m.counter(mm.STREAM_BROKEN).value == 0  # our close, not a failure
+
+
+# -- chaos on stream writes ---------------------------------------------------
+
+
+def _chaos_wrap(plan):
+    from distributed_sgd_tpu import chaos as chaos_mod
+
+    state = chaos_mod.ChaosState(chaos_mod.parse_plan(plan))
+    sent = []
+
+    class _Inner:
+        def __call__(self, it, timeout=None, **kw):
+            sent.extend(it)
+            return sent
+
+    c = chaos_mod._ChaosStreamCallable(_Inner(), "FitStream",
+                                       ("h", 1), ("h", 2), state)
+    return c, sent
+
+
+def test_chaos_stream_drop_loses_frames_not_the_stream():
+    c, sent = _chaos_wrap("seed=3;drop=1.0")
+    c(iter([_frame(), _frame(), _frame()]))
+    assert sent == []  # every frame black-holed; the iterator survived
+
+
+def test_chaos_stream_dup_doubles_frames():
+    c, sent = _chaos_wrap("seed=3;dup=1.0")
+    c(iter([_frame(1), _frame(2)]))
+    assert len(sent) == 4
+    assert sent[0].request.fit_token == sent[1].request.fit_token == 1
+
+
+def test_chaos_stream_error_tears_the_stream_down():
+    from distributed_sgd_tpu.chaos import ChaosRpcError
+
+    c, sent = _chaos_wrap("seed=3;error=1.0")
+    with pytest.raises(ChaosRpcError):
+        c(iter([_frame()]))
+    assert sent == []
+
+
+def test_stream_rpc_error_surface():
+    e = StreamRpcError(grpc.StatusCode.UNAVAILABLE, "x")
+    assert e.code() == grpc.StatusCode.UNAVAILABLE
+    assert "UNAVAILABLE" in str(e)
