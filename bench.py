@@ -388,6 +388,26 @@ def main() -> None:
 
         bench_serve.main(smoke="--smoke" in sys.argv)
         return
+    if "--scale" in sys.argv:
+        # master-plane scaling gate (docs/SCALING.md): rounds/s vs worker
+        # count N in {4..64} at fixed global batch, serialized knobs-off
+        # master vs the O(N) plane (DSGD_STREAM + DSGD_FANIN_LANES +
+        # DSGD_STAGE_POOL) — hard-asserts >= 1.5x at N=32 with weight
+        # drift exactly 0.0 at every N.  --smoke is the CI-sized mode.
+        from benches import bench_scale
+
+        bench_scale.main(smoke="--smoke" in sys.argv)
+        return
+    if "--soak" in sys.argv:
+        # sustained autoscale chaos soak (ROADMAP item 4): >= 24 workers
+        # for minutes under seeded drop/delay/partition weather while a
+        # join/leave schedule churns membership — gates zero live-worker
+        # evictions, O(delta)-bounded reload rows, and convergence parity.
+        # --smoke is the CI-sized mode.
+        from benches import bench_soak
+
+        bench_soak.main(smoke="--smoke" in sys.argv)
+        return
     if "--chaos" in sys.argv:
         # chaos gate (docs/FAULT_TOLERANCE.md): sync training under the
         # canonical seeded fault plan, quorum on vs off — asserts

@@ -1,0 +1,232 @@
+"""Master-plane scaling gate: rounds/s vs worker count, serialized vs O(N)
+(docs/SCALING.md).
+
+The reference master fans out one request per worker per round and pays a
+serial per-worker cost at EVERY master-side stage — sample draw, request
+build, send, reply decode — so rounds/s degrades linearly as N grows even
+when the per-worker compute shrinks to keep the global batch fixed.  PR 12
+removed the per-call RPC floor (DSGD_STREAM); this bench gates the rest of
+the O(N) master plane (ISSUE 15): sharded fan-in decode lanes
+(DSGD_FANIN_LANES) + pooled dispatch staging (DSGD_STAGE_POOL) on top of
+the streams, against the fully serialized knobs-off master.
+
+Sweep: N in {4, 16, 32, 64} in-process loopback workers (real gRPC, one
+DevCluster per N) at a FIXED GLOBAL BATCH — per-worker batch = global/N,
+so rounds/epoch is constant across N and a throughput change isolates the
+master's per-round cost, not the workload.  Per N, `reps` interleaved
+(serialized, scaled) fit pairs on the same warm cluster, best-of-reps.
+
+Gates (hard asserts, smoke and full):
+
+- scaled rounds/s >= 1.5x serialized rounds/s at N=32;
+- weight drift exactly 0.0 between the two configs at EVERY swept N (the
+  lanes keep one send-ordered f32 accumulation chain; the stager replays
+  the serial sample stream; streams are bit-identical since PR 12);
+- knobs-off staging counters stay zero (the serialized fits must never
+  touch the stage plane).
+
+Reported through benches/regress.py: `*_rounds_per_s` rows gate UP per N,
+`*_scale_eff` rows (rounds/s at N normalized to the smallest swept N,
+higher is better — how flat the master's per-round cost stays) gate UP
+through the new scale_eff metric class.
+
+Run: ``python bench.py --scale [--smoke]``.  One JSON line on stdout;
+diagnostics on stderr.  The chaos-weather endurance sibling is
+``python bench.py --soak`` (benches/bench_soak.py).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+LANES = 4
+POOL = 4
+SPEEDUP_GATE_N = 32
+SPEEDUP_GATE_X = 1.5
+
+SMOKE = dict(
+    n=1280, n_features=512, nnz=8, global_batch=128, epochs=5, lr=0.5,
+    sweep=(4, 32), reps=4,
+)
+FULL = dict(
+    n=1280, n_features=512, nnz=8, global_batch=128, epochs=8, lr=0.5,
+    sweep=(4, 16, 32, 64), reps=3,
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _build(cfg: dict):
+    from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+    from distributed_sgd_tpu.data.synthetic import rcv1_like
+
+    data = rcv1_like(cfg["n"], n_features=cfg["n_features"], nnz=cfg["nnz"],
+                     seed=15, idf_values=True)
+    train, test = train_test_split(data)
+    ds = dim_sparsity(train)
+
+    def make():
+        from distributed_sgd_tpu.models.linear import make_model
+
+        return make_model("hinge", 1e-5, train.n_features, dim_sparsity=ds)
+
+    return train, test, make
+
+
+def _fit(cluster, cfg: dict, batch: int, scaled: bool):
+    """One timed fit; returns (rounds_per_s, weights, stage_hits)."""
+    from distributed_sgd_tpu.utils import metrics as mm
+
+    g = mm.global_metrics()
+    r0 = g.counter(mm.SYNC_ROUNDS).value
+    h0 = g.counter(mm.STAGE_HITS).value
+    t0 = time.perf_counter()
+    res = cluster.master.fit_sync(
+        max_epochs=cfg["epochs"], batch_size=batch,
+        learning_rate=cfg["lr"], grad_timeout_s=30.0,
+        stream=scaled, fanin_lanes=LANES if scaled else 0,
+        stage_pool=POOL if scaled else 0,
+    )
+    wall = time.perf_counter() - t0
+    rounds = g.counter(mm.SYNC_ROUNDS).value - r0
+    hits = g.counter(mm.STAGE_HITS).value - h0
+    return rounds / wall, np.asarray(res.state.weights), hits, rounds, wall
+
+
+def _sweep_point(train, test, make, cfg: dict, n_workers: int) -> dict:
+    """One N: fresh cluster, prewarm, `reps` interleaved config pairs."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+
+    batch = cfg["global_batch"] // n_workers
+    assert batch >= 1, "sweep exceeds the global batch"
+    # one shared CPU device for every worker: this bench isolates the
+    # MASTER plane's per-round cost, and the tier-1 harness's 8-virtual-
+    # device mesh (tests/conftest.py XLA flag) would otherwise spread the
+    # workers over 8 device contexts whose extra executor threads eat the
+    # very idle gaps the stage pool overlaps into — the standalone and
+    # under-pytest measurements must agree
+    import jax
+
+    device = [jax.devices()[0]]
+    t_up = time.perf_counter()
+    with DevCluster(make(), train, test, n_workers=n_workers, seed=0,
+                    devices=device) as c:
+        up_s = time.perf_counter() - t_up
+        # prewarm every worker's jitted gradient at its batch bucket and
+        # the master's eval binding: the timed fits must measure the
+        # master plane, not XLA compile latency
+        zeros = np.zeros(train.n_features, dtype=np.float32)
+        warm_ids = np.arange(batch, dtype=np.int64)
+        for w in c.workers:
+            w.compute_gradient(zeros, warm_ids)
+        c.master.local_loss(zeros)
+        best = {"serial": 0.0, "scaled": 0.0}
+        weights = {}
+        hits = 0
+        for rep in range(cfg["reps"]):
+            for name, scaled in (("serial", False), ("scaled", True)):
+                rps, w_fit, h, rounds, wall = _fit(c, cfg, batch, scaled)
+                best[name] = max(best[name], rps)
+                weights.setdefault(name, w_fit)
+                if scaled:
+                    hits += h
+                else:
+                    assert h == 0, (
+                        "a knobs-off fit touched the stage plane "
+                        f"({h} stage hits at N={n_workers})")
+                log(f"  N={n_workers:3d} {name:6s} rep {rep}: "
+                    f"{rps:7.1f} rounds/s ({rounds} rounds / {wall:.2f}s)")
+    drift = float(np.max(np.abs(weights["scaled"] - weights["serial"])))
+    assert drift == 0.0, (
+        f"scaled weights drifted from the serialized master at "
+        f"N={n_workers} (max |dw| = {drift:g}) — the O(N) plane must be "
+        f"bit-exact")
+    assert hits > 0, (
+        f"the scaled fits at N={n_workers} never dispatched a pre-staged "
+        f"draw — the stage plane is not engaged")
+    speedup = best["scaled"] / best["serial"] if best["serial"] else 0.0
+    log(f"  N={n_workers:3d}: serial {best['serial']:.1f} vs scaled "
+        f"{best['scaled']:.1f} rounds/s -> {speedup:.2f}x "
+        f"(drift {drift}, cluster up in {up_s:.1f}s)")
+    return {"n": n_workers, "serial_rps": best["serial"],
+            "scaled_rps": best["scaled"], "speedup": speedup,
+            "drift": drift}
+
+
+def run_bench(smoke: bool = False) -> dict:
+    cfg = SMOKE if smoke else FULL
+    label = "smoke" if smoke else "full"
+    log(f"scale bench ({label}): n={cfg['n']} dim={cfg['n_features']} "
+        f"global_batch={cfg['global_batch']} epochs={cfg['epochs']} "
+        f"sweep={cfg['sweep']} lanes={LANES} pool={POOL}")
+    train, test, make = _build(cfg)
+    points = [_sweep_point(train, test, make, cfg, n) for n in cfg["sweep"]]
+    by_n = {p["n"]: p for p in points}
+    base_n = min(cfg["sweep"])
+    gate_n = SPEEDUP_GATE_N if SPEEDUP_GATE_N in by_n else max(cfg["sweep"])
+    gate = by_n[gate_n]
+    log(f"gate: {gate['speedup']:.2f}x at N={gate_n} "
+        f"(bar >= {SPEEDUP_GATE_X}x), drift 0.0 at every N")
+    assert gate["speedup"] >= SPEEDUP_GATE_X, (
+        f"scaled master {gate['speedup']:.2f}x at N={gate_n} — below the "
+        f">= {SPEEDUP_GATE_X}x bar over the serialized master")
+
+    result = {
+        "metric": f"scale_{label}",
+        # headline, gated lower-is-better: seconds per round of the scaled
+        # master at the gate point (1 / rounds_per_s keeps the `value`
+        # convention meaningful)
+        "value": round(1.0 / gate["scaled_rps"], 5),
+        "unit": "s/round",
+        "speedup_gate_n": gate_n,
+        "speedup_gate_info": round(gate["speedup"], 3),
+        "global_batch": cfg["global_batch"],
+        "lanes": LANES,
+        "pool": POOL,
+    }
+    for p in points:
+        n = p["n"]
+        result[f"n{n}_serial_rounds_per_s"] = round(p["serial_rps"], 1)
+        result[f"n{n}_scaled_rounds_per_s"] = round(p["scaled_rps"], 1)
+        result[f"n{n}_speedup_info"] = round(p["speedup"], 3)
+        # scaling efficiency: how flat the scaled master's rounds/s stays
+        # as N grows (1.0 = perfectly flat); gated UP via the regress
+        # scale_eff class — a collapse means a stage went serial-in-N
+        result[f"n{n}_scale_eff"] = round(
+            p["scaled_rps"] / by_n[base_n]["scaled_rps"], 4)
+        result[f"n{n}_drift"] = p["drift"]
+    return result
+
+
+def main(smoke: bool = False) -> None:
+    result = run_bench(smoke=smoke)
+    try:
+        from benches import regress
+
+        regressions, lines = regress.check(result, regress.load_history())
+        result["regressed"] = regressions
+        log(f"regression gate vs stored history, tolerance "
+            f"{regress.DEFAULT_TOLERANCE:.0%}:")
+        for ln in lines:
+            log(ln)
+        if regressions:
+            log(f"FAIL: regressed metrics: {', '.join(regressions)} "
+                f"(run NOT recorded)")
+        else:
+            regress.record(result)
+            log("PASS: run appended to benches/history.json")
+    except Exception as e:  # noqa: BLE001 - gating must not break the bench
+        log(f"regression gate skipped: {e}")
+        result["regressed"] = None
+        result["gate_error"] = str(e)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main(smoke="--smoke" in sys.argv)

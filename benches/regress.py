@@ -82,6 +82,12 @@ DEFAULT_TOLERANCE = 0.35  # shared-chip variance headroom (TIMING metrics)
 #   direction() resolves `_per_s` first, and this class entry pins the
 #   pairing explicitly so the rule can never silently reorder.  The
 #   35% band matches the loopback-RPC timing variance the rows measure;
+# - scaling efficiency (`*_scale_eff`, the bench_scale worker-count
+#   sweep): HIGHER is better — the ratio of rounds/s at a swept worker
+#   count to rounds/s at the smallest count, i.e. how flat the master's
+#   per-round cost stays as N grows.  A collapse here means a master
+#   stage went serial-in-N again; the 35% band matches the loopback
+#   throughput variance of the rows the ratio is built from;
 # - everything else (seconds, rates, `value`): the 35% shared-chip knob.
 CLASS_TOLERANCES = (
     (("_loss", "_acc"), 0.02),
@@ -89,6 +95,7 @@ CLASS_TOLERANCES = (
     (("_p50_s", "_p99_s"), 0.50),
     (("_spinup_s",), 0.50),
     (("_rounds_per_s",), 0.35),
+    (("_scale_eff",), 0.35),
 )
 
 
@@ -97,13 +104,14 @@ def tolerance_for(name: str, timing_tolerance: float = DEFAULT_TOLERANCE,
     """The gate tolerance for one metric: its class band, or the timing
     tolerance (the CLI `--tolerance` knob) when unclassed.
 
-    Chaos/quorum series are exempt from the tight loss/acc band: their
-    loss depends on WHICH replies beat a wall-clock soft deadline, not
-    only on the seed — bench_chaos's own in-run parity bound
-    (max(1.02*base, base+0.02), ~12% at typical losses) is the real
-    gate, and a 2% history band would turn normal quorum-timing noise
-    into false alarms."""
-    if (series or "").startswith("chaos") and name.endswith(("_loss", "_acc")):
+    Chaos/quorum series — the soak included — are exempt from the tight
+    loss/acc band: their loss depends on WHICH replies beat a wall-clock
+    soft deadline, not only on the seed — bench_chaos's/bench_soak's own
+    in-run parity bound (max(1.02*base, base+0.02), ~12% at typical
+    losses) is the real gate, and a 2% history band would turn normal
+    quorum-timing noise into false alarms."""
+    if ((series or "").startswith(("chaos", "soak"))
+            and name.endswith(("_loss", "_acc"))):
         return timing_tolerance
     for suffixes, tol in CLASS_TOLERANCES:
         if name.endswith(suffixes):
@@ -124,8 +132,10 @@ def direction(name: str) -> Optional[str]:
     if "floor" in name or "jvm" in name:
         return None
     # rate suffixes first: "*_per_s" would otherwise match the "_s"
-    # lower-is-better check and gate throughput backwards
-    if name.endswith(("_per_s", "_acc")):
+    # lower-is-better check and gate throughput backwards; scaling
+    # efficiency (`*_scale_eff`, bench_scale.py) is a higher-is-better
+    # throughput ratio with no timing-shaped suffix to collide with
+    if name.endswith(("_per_s", "_acc", "_scale_eff")):
         return "up"
     # wire-traffic series (benches/bench_rpc_sync.py, bench_comms.py):
     # bytes gate DOWN so a PR that silently re-inflates the broadcast or

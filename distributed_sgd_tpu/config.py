@@ -187,6 +187,19 @@ class Config:
     # stay unary (mixed fleets keep working).  Off (default): no Frame is
     # ever constructed and the wire stays byte-identical to the seed.
     stream: bool = False  # sync rpc: persistent per-worker gradient streams
+    # O(N) master plane (docs/SCALING.md; engine=rpc sync fits only).
+    # Both default off: the default fan-in decode and dispatch call graphs
+    # stay byte-identical to the serialized master.
+    # fanin_lanes=K shards the fan-in DECODE into K lanes — each reply's
+    # wire->ndarray parse runs in its own gRPC arrival callback instead of
+    # queueing on one decoder lock, while the float accumulation stays one
+    # send-ordered chain (weights byte-identical to K=0, asserted).
+    fanin_lanes: int = 0
+    # stage_pool=P stages round t+1's dispatch during round t's barrier on
+    # a P-thread pool: every worker's sample draw (determinism-safe) and
+    # request build (weight arm attached) leave the dispatch critical
+    # path, for stream and unary fits alike.
+    stage_pool: int = 0
     # tensor parallelism: shard the blocked weight rows over F feature
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
@@ -269,6 +282,14 @@ class Config:
     # baseline + rejected set) instead of re-canarying it.  None
     # (default): router state is in-memory only, byte-identical behavior.
     serve_state: Optional[str] = None
+    # canary probe-set refresh cadence (seconds; docs/SERVING.md): with
+    # f > 0 the router re-reads DSGD_SERVE_PROBE every f seconds (mtime-
+    # gated) and rotates the fresh held-out rows in, re-anchoring the
+    # canary baseline on the PROMOTED version's loss over the new rows —
+    # a long-running fleet's gate tracks live traffic instead of
+    # fossilizing on the rows it started with.  0 (default): the probe
+    # set and baseline are fixed at fleet start, byte-identical behavior.
+    serve_probe_refresh_s: float = 0.0
 
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
@@ -341,6 +362,15 @@ class Config:
             raise ValueError("steps_per_dispatch must be >= 1")
         if self.local_steps < 1:
             raise ValueError("local_steps must be >= 1")
+        if self.fanin_lanes < 0:
+            raise ValueError(
+                "DSGD_FANIN_LANES must be >= 0 (0 = single-lock fan-in "
+                "decode; K shards the decode into K lanes)")
+        if self.stage_pool < 0:
+            raise ValueError(
+                "DSGD_STAGE_POOL must be >= 0 (0 = draws and request "
+                "builds on the dispatch path; P stages them on a P-thread "
+                "pool during the previous barrier)")
         if self.compress_k <= 0:
             raise ValueError("compress_k must be > 0 (fraction of dim or count)")
         if self.feature_shards < 1:
@@ -446,6 +476,16 @@ class Config:
             raise ValueError("serve_hedge_ms must be >= 0 (0 = no hedging)")
         if self.serve_health_s <= 0:
             raise ValueError("serve_health_s must be > 0")
+        if self.serve_probe_refresh_s < 0:
+            raise ValueError(
+                "DSGD_SERVE_PROBE_REFRESH_S must be >= 0 (0 = fixed probe "
+                "set)")
+        if (self.serve_probe_refresh_s > 0 and not self.serve_probe
+                and self.role_override in ("route", "serve")):
+            raise ValueError(
+                "DSGD_SERVE_PROBE_REFRESH_S > 0 needs DSGD_SERVE_PROBE: "
+                "the refresh re-reads the probe file on its cadence "
+                "(docs/SERVING.md)")
 
     @property
     def role(self) -> str:
@@ -524,6 +564,8 @@ class Config:
             local_steps=_env("DSGD_LOCAL_STEPS", cls.local_steps, int),
             delta_broadcast=_env("DSGD_DELTA_BROADCAST", cls.delta_broadcast, bool),
             stream=_env("DSGD_STREAM", cls.stream, bool),
+            fanin_lanes=_env("DSGD_FANIN_LANES", cls.fanin_lanes, int),
+            stage_pool=_env("DSGD_STAGE_POOL", cls.stage_pool, int),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
             host_devices=_env("DSGD_HOST_DEVICES", cls.host_devices, int),
             compile_cache=_env("DSGD_COMPILE_CACHE", None, str),
@@ -545,6 +587,8 @@ class Config:
             serve_hedge_ms=_env("DSGD_SERVE_HEDGE_MS", cls.serve_hedge_ms, float),
             serve_health_s=_env("DSGD_SERVE_HEALTH_S", cls.serve_health_s, float),
             serve_state=_env("DSGD_SERVE_STATE", None, str),
+            serve_probe_refresh_s=_env("DSGD_SERVE_PROBE_REFRESH_S",
+                                       cls.serve_probe_refresh_s, float),
         )
         return dataclasses.replace(cfg, **overrides)
 

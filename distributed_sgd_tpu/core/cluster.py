@@ -216,6 +216,19 @@ class DevCluster:
         w.start(wait_registered=wait_registered)
         return w
 
+    def leave_worker(self, i: int) -> WorkerNode:
+        """GRACEFUL leave of worker `i` (autoscale churn drills,
+        docs/SCALING.md soak methodology): the worker unregisters itself
+        through the real control plane and its server/channels close —
+        the counterpart of a scale-down, not a crash (no eviction, no
+        heartbeat misses).  The master's next membership read resplits;
+        the freed slot lets `add_worker` model the scale-up half.  The
+        node is removed from `self.workers` so cluster teardown does not
+        stop it twice."""
+        w = self.workers.pop(i)
+        w.stop()
+        return w
+
     def stop(self) -> None:
         for w in self.workers:
             w.stop()

@@ -130,6 +130,31 @@ class LossChecker:
             self._checks_since_save = 0
         return self.criterion is not None and self.criterion(self.smoothed)
 
+    def refresh(self, best_loss: Optional[float] = None,
+                best_weights=None) -> None:
+        """Rotate the checker's baseline onto a NEW evaluation set
+        (ROADMAP 3c: canary probe-set refresh, docs/SERVING.md).
+
+        The smoothing history and best-loss baseline are only meaningful
+        against the rows they were measured on — after the caller swaps
+        its held-out probe rows, the old numbers compare apples to
+        oranges, so refresh CLEARS them and (optionally) re-anchors
+        `best_loss`/`best_weights` from a measurement the caller already
+        took on the new rows (the serving router re-evaluates its
+        PROMOTED version there).  `best_loss=None` leaves the checker
+        baseline-less: the next check() (or canary pass) seeds it, the
+        same cold-start rule as a fresh checker.  Checkpointer state and
+        the lifetime update count are untouched — only the loss view
+        rotates, not the training lineage."""
+        self.smoothed = []
+        self.smoothed_accs = []
+        self.best_loss = float("inf")
+        self.best_weights = None
+        if best_loss is not None and np.isfinite(best_loss):
+            self.best_loss = float(best_loss)
+            if best_weights is not None:
+                self.best_weights = np.asarray(best_weights)
+
     @property
     def history(self) -> List[float]:
         """Chronological smoothed losses."""

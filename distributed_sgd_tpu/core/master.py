@@ -18,6 +18,7 @@ weight serialization at all).
 
 from __future__ import annotations
 
+import collections
 import logging
 import threading
 import time
@@ -109,41 +110,118 @@ def _await_futures(futs, bytes_counter=None):
 
 
 class _ArrivalDecoder:
-    """Send-ordered decode-on-arrival for the sync fan-in (ROADMAP item 2).
+    """Send-ordered decode-on-arrival for the sync fan-in (ROADMAP item 2),
+    optionally SHARDED into K decoder lanes (DSGD_FANIN_LANES,
+    docs/SCALING.md).
 
     The full-barrier fan-in used to decode every Gradient reply AFTER the
     barrier closed — N dim-sized scatter-decodes serialized on the
     critical path while N-1 of them could have run during the wait.  This
-    moves each reply's `codec.decode_grad_into(reply, grad_acc)` into the
-    reply's own arrival callback, constrained to SEND ORDER (the decode
-    cursor only advances over the contiguous settled prefix), so float
-    accumulation order — and therefore the resulting weights — stays
-    bit-identical to the post-barrier loop.  With in-order arrivals every
-    decode but the slowest reply's overlaps the wait; out-of-order
-    arrivals decode as soon as their prefix completes.
+    moves each reply's decode into the reply's own arrival callback,
+    constrained to SEND ORDER (the decode cursor only advances over the
+    contiguous settled prefix), so float accumulation order — and
+    therefore the resulting weights — stays bit-identical to the
+    post-barrier loop.  With in-order arrivals every decode but the
+    slowest reply's overlaps the wait; out-of-order arrivals decode as
+    soon as their prefix completes.
 
-    Lock-guarded: gRPC runs callbacks on its own threads.  Set-once per
-    index (`setdefault`), so a callback racing `finish()` can never decode
-    a reply twice.  A failed or stale reply marks the window dirty and
+    ``lanes=K >= 1`` shards the DECODE: workers map to lanes by a fixed
+    send-index assignment (``i % K``), each lane guards its own slot map
+    with its own lock, and — the point — the expensive half of the decode
+    (`codec.parse_grad`: repeated-field -> ndarray materialization, qint8
+    dequantization) runs in the arrival callback BEFORE any lock is
+    taken, so K callbacks parse concurrently instead of queueing on one
+    decoder lock.  Only the cheap float ACCUMULATION (`codec.add_parsed`)
+    is serialized, under the accumulator lock, walking the contiguous
+    settled prefix in send order.  Keeping the accumulation a single
+    send-ordered f32 chain is what makes the lanes BIT-EXACT against the
+    single-accumulator path: a per-lane partial-sum + K-way reduce would
+    regroup the float additions ((r0+r1)+(r2+r3) instead of
+    ((r0+r1)+r2)+r3) and drift in the last ulp — asserted impossible by
+    tests/test_fanin_lanes.py, which pins lanes-on weights byte-identical
+    to lanes-off across sync, quorum, retry, and compressed rounds.
+
+    ``defer=True`` (the quorum barrier's mode) parses arrivals into a
+    side table but never accumulates: the contributor set (hedge wins,
+    late originals) is only known at round close, when the caller replays
+    it in canonical order through ``add_into`` — pre-parsed replies cost
+    O(dim) adds only, unparsed ones (hedge replies arrive on unary
+    futures nobody watches) parse on the spot.
+
+    Lock discipline: parse outside every lock; lane locks guard only
+    their slot maps (set-once per index, so a callback racing `finish()`
+    can never decode a reply twice); the accumulator lock serializes the
+    cursor walk and is never held while a lane lock is awaited in the
+    other direction.  A failed or stale reply marks the window dirty and
     freezes the cursor — the caller retries the window and the
     accumulator is re-zeroed on the next attempt, so partially-decoded
-    state never leaks into an applied update."""
+    state never leaks into an applied update.  ``lanes=0`` (default)
+    keeps the pre-shard single-lock path byte-for-byte."""
 
-    def __init__(self, acc: np.ndarray):
+    def __init__(self, acc: np.ndarray, lanes: int = 0, defer: bool = False):
         self.acc = acc
+        self.lanes = max(0, int(lanes))
+        self.defer = bool(defer)
         self._lock = threading.Lock()
         self._results: Dict[int, object] = {}
         self._cursor = 0
         self.dirty = False
         self.decoded = 0
+        self.parsed = 0
+        if self.lanes:
+            k = self.lanes
+            self._lane_locks = [threading.Lock() for _ in range(k)]
+            # per-lane slot maps: index -> (reply | None, parsed | None)
+            self._lane_slots: List[Dict[int, tuple]] = [dict() for _ in range(k)]
+            # defer mode's side table: id(reply) -> (reply, parsed); the
+            # reply reference keeps the id stable until the round closes
+            self._parsed_by_reply: Dict[int, tuple] = {}
+
+    # -- shared entry points ------------------------------------------------
 
     def watch(self, i: int, fut) -> None:
-        if fut is None:
-            with self._lock:
-                self._results.setdefault(i, None)
-                self._advance()
+        if not self.lanes:
+            if fut is None:
+                with self._lock:
+                    self._results.setdefault(i, None)
+                    self._advance()
+                return
+            fut.add_done_callback(lambda f, i=i: self._on_done(i, f))
             return
-        fut.add_done_callback(lambda f, i=i: self._on_done(i, f))
+        if fut is None:
+            self._settle_lane(i, None)
+            return
+        fut.add_done_callback(lambda f, i=i: self._on_done_lane(i, f))
+
+    def finish(self, futs) -> bool:
+        """Drain any settled tail the callbacks have not reached yet (the
+        barrier already awaited every future, but gRPC's callback threads
+        may lag the main thread's own `result()`); returns clean?"""
+        if not self.lanes:
+            with self._lock:
+                for i, (_key, fut) in enumerate(futs):
+                    if i not in self._results:
+                        try:
+                            self._results[i] = (fut.result()
+                                                if fut is not None else None)
+                        except Exception:  # noqa: BLE001
+                            self._results[i] = None
+                self._advance()
+                return not self.dirty
+        for i, (_key, fut) in enumerate(futs):
+            lane = self._lane_locks[i % self.lanes]
+            with lane:
+                seen = i in self._lane_slots[i % self.lanes]
+            if not seen:
+                try:
+                    reply = fut.result() if fut is not None else None
+                except Exception:  # noqa: BLE001
+                    reply = None
+                self._settle_lane(i, reply)
+        self._advance_lanes()
+        return not self.dirty
+
+    # -- legacy single-lock path (lanes=0) ----------------------------------
 
     def _on_done(self, i: int, fut) -> None:
         try:
@@ -166,20 +244,65 @@ class _ArrivalDecoder:
             self.decoded += 1
             self._cursor += 1
 
-    def finish(self, futs) -> bool:
-        """Drain any settled tail the callbacks have not reached yet (the
-        barrier already awaited every future, but gRPC's callback threads
-        may lag the main thread's own `result()`); returns clean?"""
-        with self._lock:
-            for i, (_key, fut) in enumerate(futs):
-                if i not in self._results:
-                    try:
-                        self._results[i] = (fut.result()
-                                            if fut is not None else None)
-                    except Exception:  # noqa: BLE001
-                        self._results[i] = None
-            self._advance()
-            return not self.dirty
+    # -- sharded lanes (lanes=K) --------------------------------------------
+
+    def _on_done_lane(self, i: int, fut) -> None:
+        try:
+            reply = fut.result()
+        except Exception:  # noqa: BLE001 - classification is the barrier's job
+            reply = None
+        self._settle_lane(i, reply)
+
+    def _settle_lane(self, i: int, reply) -> None:
+        # parse BEFORE any lock: this is the concurrency the lanes buy
+        parsed = None
+        if reply is not None and not reply.stale_version:
+            parsed = codec.parse_grad(reply)
+        lane = i % self.lanes
+        with self._lane_locks[lane]:
+            slots = self._lane_slots[lane]
+            if i in slots:  # set-once: a lagging callback must not re-enter
+                return
+            slots[i] = (reply, parsed)
+        if parsed is not None:
+            with self._lock:  # exact count; defer's side table reads here too
+                self.parsed += 1
+                if self.defer:
+                    self._parsed_by_reply[id(reply)] = (reply, parsed)
+        if not self.defer:
+            self._advance_lanes()
+
+    def _advance_lanes(self) -> None:
+        if self.defer:
+            return
+        with self._lock:  # the accumulator lock: one ordered f32 chain
+            while not self.dirty:
+                lane = self._cursor % self.lanes
+                with self._lane_locks[lane]:
+                    item = self._lane_slots[lane].get(self._cursor)
+                if item is None:
+                    return
+                reply, parsed = item
+                if reply is None or reply.stale_version:
+                    self.dirty = True
+                    return
+                codec.add_parsed(parsed, self.acc)
+                self.decoded += 1
+                self._cursor += 1
+
+    def add_into(self, reply, out: np.ndarray) -> None:
+        """Defer mode's round-close accumulate: reuse the arrival
+        callback's parse when one landed for this reply object, parse on
+        the spot otherwise (hedge replies, late settles) — the float adds
+        are `decode_grad_into`'s exactly, in the caller's order."""
+        item = None
+        if self.lanes and self.defer:
+            with self._lock:
+                item = self._parsed_by_reply.get(id(reply))
+        if item is not None and item[0] is reply:
+            codec.add_parsed(item[1], out)
+        else:
+            codec.decode_grad_into(reply, out)
 
 
 class _LatencyEwma:
@@ -306,6 +429,107 @@ def _draw_ids(rng: np.random.Generator, part: np.ndarray, start: int,
     return np.asarray(part)[rng.choice(len(part), size=take, replace=False)]
 
 
+class _DispatchStager:
+    """Pooled round-(t+1) dispatch staging (DSGD_STAGE_POOL,
+    docs/SCALING.md).
+
+    The serialized master draws every worker's sample ids ON the dispatch
+    critical path, one worker after another, each round.  With staging
+    on, round t+1's draws run on the stage pool DURING round t's barrier
+    (the main thread is blocked in gRPC with the GIL released, so the
+    staging thread genuinely overlaps) — dispatch then starts from a
+    ready ids-by-worker map.
+
+    Determinism is the whole contract.  The sample stream is one
+    epoch-keyed np.random.Generator consumed in (round, worker) order;
+    a resumed fit replays it from a snapshotted bit-generator state.  So:
+
+    - the pre-draw consumes the SAME values, in the SAME order, the
+      serial path's next round would have consumed (one staging task
+      draws all workers sequentially — never one task per worker);
+    - the pre-draw snapshots the generator state first, and ANY
+      discard — a retry re-dispatching the same cursor, a resplit
+      changing membership/partitions, an epoch ending — RESTORES it, so
+      the serial path's draw at that point reads the exact values it
+      would have read had staging never run;
+    - `rng_state()` exposes the state a SERIAL run would hold right now
+      (the pre-draw base while a stage is pending), which is what the
+      crash-safe fit-state snapshot must persist — persisting the
+      post-pre-draw state would make a resumed fit skip a round's draws.
+
+    The same pool is handed to `_BroadcastState` so per-worker request
+    builds (weight-arm attach + frame construction) fan out across it at
+    encode time; `hits`/`discards` feed master.sync.stage.* counters."""
+
+    def __init__(self, pool_size: int):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.pool = ThreadPoolExecutor(
+            max_workers=max(1, int(pool_size)), thread_name_prefix="stage-pool")
+        self._fut = None
+        self._base_state = None
+        self._tag: Optional[Tuple[int, int]] = None
+        self._keys: List[Tuple[str, int]] = []
+        self.hits = 0
+        self.discards = 0
+
+    def stage(self, rng, keys, parts, epoch: int, cursor: int,
+              span: int) -> None:
+        """Arm one pre-draw for (epoch, cursor); call only with no stage
+        pending (take/discard every round)."""
+        assert self._fut is None, "a staged draw is already pending"
+        self._base_state = rng.bit_generator.state
+        self._tag = (int(epoch), int(cursor))
+        self._keys = list(keys)
+        parts = list(parts)
+
+        def _draw_all():
+            # sequential, in fan-out order: the exact consumption pattern
+            # of the serial dispatch loop
+            return [_draw_ids(rng, part, cursor, span) for part in parts]
+
+        self._fut = self.pool.submit(_draw_all)
+
+    def take(self, rng, keys, epoch: int, cursor: int):
+        """The staged ids-by-worker map when the staging assumptions still
+        hold (same epoch, same window cursor, same membership); None
+        otherwise — the generator state is restored and the caller draws
+        serially, reading the values a never-staged run would read."""
+        if self._fut is None:
+            return None
+        draws = self._fut.result()  # join: surfaces staging exceptions
+        self._fut = None
+        if self._tag != (int(epoch), int(cursor)) or list(keys) != self._keys:
+            rng.bit_generator.state = self._base_state
+            self._base_state = None
+            self.discards += 1
+            return None
+        self._base_state = None
+        self.hits += 1
+        return dict(zip(self._keys, draws))
+
+    def discard(self, rng) -> None:
+        """Membership moved under the stage (resplit): drop the pre-drawn
+        ids and restore the generator."""
+        if self._fut is None:
+            return
+        self._fut.result()
+        self._fut = None
+        rng.bit_generator.state = self._base_state
+        self._base_state = None
+        self.discards += 1
+
+    def rng_state(self, rng):
+        """The bit-generator state a SERIAL run would hold right now — the
+        pre-draw base while a stage is pending, the live state otherwise.
+        Crash-safe fit-state snapshots persist THIS, never the raw state."""
+        return (self._base_state if self._fut is not None
+                else rng.bit_generator.state)
+
+    def close(self) -> None:
+        self.pool.shutdown(wait=False)
+
+
 class _BroadcastState:
     """Versioned master->worker weight broadcast for fit_sync
     (docs/SYNC_PIPELINE.md).
@@ -331,9 +555,17 @@ class _BroadcastState:
     SPARSE_BREAK_EVEN = 0.5  # changed fraction above which dense is smaller
 
     def __init__(self, delta_broadcast: bool, metrics, versioned: bool = False,
-                 encode_ahead: bool = True):
+                 encode_ahead: bool = True, stage_pool=None):
         self.delta_broadcast = delta_broadcast
         self.metrics = metrics
+        # pooled dispatch (DSGD_STAGE_POOL, docs/SCALING.md): when a stage
+        # pool executor is handed in, _build_staged fans the per-worker
+        # request builds (weight-arm attach included) across it instead of
+        # building N requests serially on the one encoder thread — and
+        # staging is armed for UNARY fits too (raw GradientRequests
+        # instead of stream Frames), so the serialized per-worker build
+        # leaves the dispatch critical path on both transports
+        self._stage_exec = stage_pool
         # encode-ahead (ROADMAP item 2): `advance()` hands the new
         # version's wire forms (full tensor bytes + the np.nonzero sparse
         # delta) to a single background encoder thread, overlapping the
@@ -374,18 +606,23 @@ class _BroadcastState:
         # when reality moved (stale fallback, resplit, retry window).
         self._stage_keys: list = []
         self._stage_ctx: Optional[Tuple[int, int, int, float]] = None
+        self._stage_frames = True
         self._stage_lock = threading.Lock()
         self._staged: Dict[Tuple[str, int], tuple] = {}
 
     def stage_for(self, keys, fit_token: int, local_steps: int,
-                  batch_size: int, learning_rate: float) -> None:
-        """Arm (or re-arm after a membership change) frame staging for
-        `keys`; takes effect from the next advance().  Only the streaming
-        dispatch path ever calls this — the unary plane keeps populate()
-        and its call graph untouched."""
+                  batch_size: int, learning_rate: float,
+                  frames: bool = True) -> None:
+        """Arm (or re-arm after a membership change) request staging for
+        `keys`; takes effect from the next advance().  `frames=True`
+        stages stream `pb.Frame`s (the DSGD_STREAM dispatch path);
+        `frames=False` stages raw `pb.GradientRequest`s for the unary
+        plane (DSGD_STAGE_POOL) — with neither knob on, nothing ever
+        calls this and populate()'s call graph stays untouched."""
         self._stage_keys = list(keys)
         self._stage_ctx = (int(fit_token), int(local_steps),
                            int(batch_size), float(learning_rate))
+        self._stage_frames = bool(frames)
         with self._stage_lock:
             self._staged = {}
 
@@ -427,15 +664,25 @@ class _BroadcastState:
             self._build_staged(w)
 
     def _build_staged(self, w: np.ndarray) -> None:
-        """Encoder-thread tail: one ready-to-send Frame per staged worker
-        for the NEXT window.  Wire accounting stays at dispatch time
-        (take_staged_frame), so counters equal the populate() path's."""
+        """Encoder-thread tail: one ready-to-send Frame (stream) or
+        GradientRequest (unary, stage-pool fits) per staged worker for the
+        NEXT window, fanned across the stage pool when one was handed in
+        (per-worker weight-arm attach is the O(N x dim) serial wall this
+        removes).  Wire accounting stays at dispatch time
+        (take_staged_frame / take_staged_request), so counters equal the
+        populate() path's."""
         token, k, bs, lr = self._stage_ctx
         version = self.version
-        staged = {}
-        for key in self._stage_keys:
-            frame = pb.Frame()
-            req = frame.request
+        frames = self._stage_frames
+
+        def _build(key):
+            if frames:
+                frame = pb.Frame()
+                req = frame.request
+                msg = frame
+            else:
+                req = pb.GradientRequest()
+                msg = req
             req.fit_token = token
             if k > 1:
                 req.local_steps = k
@@ -443,26 +690,43 @@ class _BroadcastState:
                 req.learning_rate = lr
             assumed = self._worker_ver.get(key)
             form, nbytes = self._attach_arm(req, key, w)
-            staged[key] = (frame, form, nbytes, assumed, version)
+            return key, (msg, form, nbytes, assumed, version)
+
+        keys = list(self._stage_keys)
+        if self._stage_exec is not None and len(keys) > 1:
+            staged = dict(self._stage_exec.map(_build, keys))
+        else:
+            staged = dict(_build(key) for key in keys)
         with self._stage_lock:
             self._staged = staged
 
-    def take_staged_frame(self, key):
-        """The pre-staged frame for `key` if its staging assumptions still
-        hold (same broadcast version, same acknowledged worker version);
-        None otherwise — the caller builds and populates a fresh frame.
-        Joins the encoder first, exactly like populate()'s lazy reads, and
-        accounts the send here so metrics match the unary path."""
+    def _take_staged(self, key, frames: bool):
+        """The pre-staged message for `key` if its staging assumptions
+        still hold (same broadcast version, same acknowledged worker
+        version, same transport); None otherwise — the caller builds and
+        populates a fresh one.  Joins the encoder first, exactly like
+        populate()'s lazy reads, and accounts the send here so metrics
+        match the unstaged path."""
         self._join_encode()
         with self._stage_lock:
+            if self._stage_frames != frames:
+                return None
             item = self._staged.pop(key, None)
         if item is None:
             return None
-        frame, form, nbytes, assumed, version = item
+        msg, form, nbytes, assumed, version = item
         if version != self.version or self._worker_ver.get(key) != assumed:
             return None  # stale fallback / resplit moved under the stage
         metrics_mod.record_broadcast(self.metrics, form, nbytes)
-        return frame
+        return msg
+
+    def take_staged_frame(self, key):
+        """Stream dispatch's staged `pb.Frame`, or None (build fresh)."""
+        return self._take_staged(key, frames=True)
+
+    def take_staged_request(self, key):
+        """Unary dispatch's staged `pb.GradientRequest`, or None."""
+        return self._take_staged(key, frames=False)
 
     def _join_encode(self) -> None:
         f = self._enc_future
@@ -574,6 +838,12 @@ class MasterNode:
         self.test = test
         self.expected_workers = expected_workers
         self.seed = seed
+        # O(N) master plane defaults (docs/SCALING.md): fit_sync resolves
+        # its fanin_lanes / stage_pool parameters against these when the
+        # caller passes None (main.py passes the DSGD_* config values
+        # explicitly; tests and embedders may pin the attributes instead)
+        self.fanin_lanes = 0
+        self.stage_pool = 0
 
         self._workers: Dict[Tuple[str, int], WorkerStub] = {}
         self._channels: Dict[Tuple[str, int], grpc.Channel] = {}
@@ -715,42 +985,137 @@ class MasterNode:
         return self.telemetry.scrape(self._members(), self.rpc_policy,
                                      min_age_s=min_age_s)
 
+    # bounded liveness-probe pool (docs/SCALING.md): at most this many
+    # Ping futures in flight at once — at O(N) workers a thundering-herd
+    # sweep would hold N channels' worth of pending probes while the
+    # per-probe deadline bounds each one anyway.  Probes past the cap
+    # defer one wheel quantum; liveness latency stays per-worker.
+    HB_PROBE_POOL = 16
+
     def _heartbeat_loop(self, interval_s: float, max_failures: int = 3) -> None:
+        """O(1)-latency liveness (docs/SCALING.md): per-worker probes on a
+        shared deadline wheel instead of the old all-members sweep.
+
+        The sweep awaited EVERY probe before the next cycle — one wedged
+        peer stretched every worker's liveness cadence by the probe
+        deadline, so eviction latency grew with the slowest member.  Here
+        each worker owns a wheel entry: its probe fires at its own due
+        time, settles on its own deadline, and re-arms itself `interval_s`
+        after completion — a slow peer delays only itself.  Initial due
+        times stagger across one interval so N probes never land as one
+        herd.  Eviction decisions (the PR 6 semantics: `max_failures`
+        consecutive misses, success resets, unregister_worker(evicted=
+        True)) run on THIS thread — gRPC callbacks only enqueue
+        completions — and the telemetry piggyback keeps its cadence on a
+        sidecar thread so a slow scrape never delays a probe."""
+        from distributed_sgd_tpu.rpc.stream import Wheel
+
         tracker = _FailureTracker(max_failures)
         # probe deadline: the interval, capped by the policy deadline so a
         # long interval doesn't grant a wedged peer a long blocking probe
         probe_timeout = min(interval_s, self.rpc_policy.deadline_s)
-        while not self._hb_stop.wait(interval_s):
-            members = self._members()
-            # telemetry piggyback (docs/OBSERVABILITY.md): the scrape rides
-            # the liveness cadence — concurrent futures bounded by the
-            # probe deadline, breaker-consulting, failures degrade to
-            # counters — so a dead worker can delay but never stall the
-            # eviction probes below
-            if self.telemetry is not None:
-                self.telemetry.scrape(members, self.rpc_policy,
+        # telemetry piggyback (docs/OBSERVABILITY.md): the scrape rides
+        # the liveness cadence — concurrent futures bounded by the probe
+        # deadline, breaker-consulting, failures degrade to counters — on
+        # its own sidecar thread, so a degraded scrape can delay the VIEW
+        # but never the eviction probes.  Armed lazily each tick because
+        # enable_telemetry() typically runs AFTER start().
+        scrape_armed = False
+
+        def _scrape_loop():
+            while not self._hb_stop.wait(interval_s):
+                self.telemetry.scrape(self._members(), self.rpc_policy,
                                       deadline_s=probe_timeout)
-            # probe concurrently so one dead worker costs one timeout, not D
-            futs = []
-            for key, stub in members:
+
+        wheel = Wheel(name="heartbeat-wheel")
+        due_ready: "collections.deque" = collections.deque()  # keys due now
+        completions: "collections.deque" = collections.deque()  # (key, ok)
+        wake = threading.Event()
+        scheduled: set = set()   # keys with a wheel entry or probe in flight
+        in_flight: set = set()
+        deferred: List[Tuple[str, int]] = []  # due past the probe-pool cap
+
+        def _fire(key):
+            due_ready.append(key)
+            wake.set()
+
+        def _probe(key, stub):
+            in_flight.add(key)
+            try:
+                fut = stub.Ping.future(pb.Empty(), timeout=probe_timeout)
+            except ValueError:  # channel closed under us (unregister/stop)
+                completions.append((key, False))
+                wake.set()
+                return
+
+            def _done(f, key=key):
                 try:
-                    futs.append((key, stub.Ping.future(pb.Empty(),
-                                                       timeout=probe_timeout)))
-                except ValueError:  # channel closed under us (unregister/stop)
-                    futs.append((key, None))
-            ok, failed = _await_futures(futs)
-            for key, _ in ok:
-                tracker.record_ok(key)
-            for key, _ in failed:
+                    f.result()
+                    completions.append((key, True))
+                except Exception:  # noqa: BLE001 - any failure is a miss
+                    completions.append((key, False))
+                wake.set()
+
+            fut.add_done_callback(_done)
+
+        while not self._hb_stop.is_set():
+            if self.telemetry is not None and not scrape_armed:
+                scrape_armed = True
+                threading.Thread(target=_scrape_loop, daemon=True,
+                                 name="telemetry-scrape").start()
+            now = time.monotonic()
+            members = self._members()
+            stub_by_key = dict(members)
+            # new members join the wheel with phases staggered across one
+            # interval; departed members' entries die on fire (no stub)
+            fresh = [k for k, _ in members if k not in scheduled]
+            for i, key in enumerate(fresh):
+                scheduled.add(key)
+                wheel.watch(now + interval_s * (i + 1) / (len(fresh) + 1),
+                            lambda key=key: _fire(key))
+            # completions first: decide liveness on THIS thread
+            while completions:
+                key, ok = completions.popleft()
+                in_flight.discard(key)
                 with self._members_lock:
                     still_member = key in self._workers
-                if still_member:
+                if not still_member:
+                    scheduled.discard(key)
+                    tracker.record_ok(key)  # drop any stale miss count
+                    continue
+                if ok:
+                    tracker.record_ok(key)
+                else:
                     n, evict = tracker.record_failure(key)
                     self.log.warning("heartbeat miss %d/%d for %s:%d",
                                      n, max_failures, *key)
                     if evict:
                         self.log.warning("worker %s:%d declared dead", *key)
                         self.unregister_worker(*key, evicted=True)
+                        scheduled.discard(key)
+                        continue
+                wheel.watch(time.monotonic() + interval_s,
+                            lambda key=key: _fire(key))
+            # fire due probes, bounded by the probe pool
+            pending = deferred + [due_ready.popleft()
+                                  for _ in range(len(due_ready))]
+            deferred = []
+            for key in pending:
+                stub = stub_by_key.get(key)
+                if stub is None or key not in scheduled:
+                    scheduled.discard(key)
+                    # drop any stale miss count: a re-registration on the
+                    # same host:port must not inherit the departed
+                    # incarnation's consecutive-miss tally
+                    tracker.record_ok(key)
+                    continue
+                if len(in_flight) >= self.HB_PROBE_POOL:
+                    deferred.append(key)  # next wake re-offers it
+                    continue
+                _probe(key, stub)
+            wake.wait(timeout=min(interval_s, 0.5) if deferred
+                      else interval_s)
+            wake.clear()
 
     def stop(self) -> None:
         self._hb_stop.set()
@@ -855,6 +1220,7 @@ class MasterNode:
         even with tracing off; a graceful leave does not."""
         key = (host, port)
         if evicted:
+            self.metrics.counter(metrics_mod.MASTER_EVICTIONS).increment()
             flight.record("worker.evicted", worker=f"{host}:{port}")
             flight.dump("eviction")
         # the departed worker's gradient stream dies with its membership
@@ -884,7 +1250,10 @@ class MasterNode:
         for stub in remaining:  # broadcast (Master.scala:245-253)
             try:
                 stub.UnregisterSlave(node, timeout=self.rpc_policy.deadline_s)
-            except grpc.RpcError:
+            except (grpc.RpcError, ValueError):
+                # ValueError: the recipient's own channel closed under us —
+                # at O(N) churn two departures can overlap, and the second
+                # leaver's broadcast must not blow up the servicer thread
                 pass
         self.log.info("worker unregistered: %s:%d", host, port)
 
@@ -1222,6 +1591,8 @@ class MasterNode:
         fit_state_every: int = 0,
         health=None,
         stream: bool = False,
+        fanin_lanes: Optional[int] = None,
+        stage_pool: Optional[int] = None,
     ) -> FitResult:
         """Fault-tolerant sync fit, with an optional pipelined wire path.
 
@@ -1279,6 +1650,30 @@ class MasterNode:
           target a different worker than the stream's owner, and every
           quorum fire re-proves the interop path.  Off (default): no
           Frame is ever constructed, call graph byte-identical.
+        - `fanin_lanes=K` (DSGD_FANIN_LANES, docs/SCALING.md): shard the
+          fan-in DECODE into K lanes — each reply's wire->ndarray parse
+          runs in its own arrival callback without queueing on one
+          decoder lock, while the float accumulation stays ONE
+          send-ordered f32 chain, so the weights are byte-identical to
+          the unsharded path (asserted by tests/test_fanin_lanes.py).
+          Quorum rounds parse on arrival too and accumulate at round
+          close, once the contributor set is known.  The lane count is
+          pinned for the fit: changing the master's `fanin_lanes`
+          attribute mid-fit (when this parameter was None) raises.
+          None (default): resolve from `self.fanin_lanes` (0 = the
+          pre-shard single-lock decoder, byte-identical).
+        - `stage_pool=P` (DSGD_STAGE_POOL, docs/SCALING.md): stage round
+          t+1's dispatch during round t's barrier on a P-thread pool —
+          every worker's sample draw (determinism-safe: one staging task
+          consumes the epoch generator in serial order and any
+          retry/resplit restores its state, see _DispatchStager) and
+          every worker's request build (weight arm attached by the
+          encode-ahead thread, fanned across the pool), for stream AND
+          unary fits — dispatch becomes a take + samples-append + send
+          per worker.  Staged sends account the same master.sync.bcast.*
+          counters populate() would.  None (default): resolve from
+          `self.stage_pool` (0 = draws and builds on the dispatch path,
+          byte-identical).
 
         Quorum barrier (DSGD_QUORUM, docs/FAULT_TOLERANCE.md; Chen et al.
         2016's N+b backup-replica shape): with `quorum=Q` the window
@@ -1334,6 +1729,16 @@ class MasterNode:
             raise ValueError(
                 f"straggler_soft_s must be > 0, got {straggler_soft_s}")
         local_steps = max(1, int(local_steps))
+        # O(N) master plane (docs/SCALING.md): both knobs resolve against
+        # the node attributes when the parameters are None, and the lane
+        # count is PINNED for the fit — per-window decoders must all shard
+        # identically or a retry window's re-zeroed accumulator would walk
+        # a different cursor layout than the attempt it replaces
+        lanes = (self.fanin_lanes if fanin_lanes is None
+                 else int(fanin_lanes))
+        lanes = max(0, int(lanes))
+        pool_n = (self.stage_pool if stage_pool is None else int(stage_pool))
+        stager = _DispatchStager(pool_n) if pool_n and pool_n > 0 else None
         self._require_ready()
         members = self._members()
         keys = [k for k, _ in members]
@@ -1352,14 +1757,17 @@ class MasterNode:
         # quorum forces version stamping even on the plain full-tensor
         # wire: the EF rollback mask keys on step_version
         bcast = _BroadcastState(delta_broadcast, self.metrics,
-                                versioned=quorum is not None)
+                                versioned=quorum is not None,
+                                stage_pool=stager.pool if stager else None)
         use_stream = bool(stream)
-        if use_stream:
+        if use_stream or stager is not None:
             # pre-staged round dispatch: from the first advance() on, the
-            # encoder thread builds each worker's next request frame while
-            # the current window's replies are still in flight
+            # encoder thread (fanned across the stage pool when one is
+            # armed) builds each worker's next request — stream Frames or
+            # unary GradientRequests — while the current window's replies
+            # are still in flight
             bcast.stage_for(keys, fit_token, local_steps, batch_size,
-                            learning_rate)
+                            learning_rate, frames=use_stream)
         # allocation-free fan-in: one dim-sized accumulator reused by every
         # window instead of a (workers x dim) dense stack per barrier
         grad_acc = np.zeros(self.model.n_features, dtype=np.float32)
@@ -1517,23 +1925,40 @@ class MasterNode:
                     batch = resume_batch
                     resume_rng_state = None
                 while batch < max_samples:
+                    # lane pin: the sharded decoder's cursor layout must be
+                    # identical across every attempt of a window — an
+                    # attribute flip mid-fit is refused, not absorbed
+                    live_lanes = (self.fanin_lanes if fanin_lanes is None
+                                  else fanin_lanes)
+                    if max(0, int(live_lanes)) != lanes:
+                        raise RuntimeError(
+                            f"fan-in lane count changed mid-fit "
+                            f"({lanes} -> {live_lanes}): the lane layout is "
+                            f"pinned at fit start (docs/SCALING.md)")
                     # live membership: heartbeat-driven unregister_worker (or a
                     # graceful leave) reaches the loop here, not at fit start
                     current = self._members()
                     if [k for k, _ in current] != keys:
                         if not current:
                             raise RuntimeError("all workers lost mid-fit")
+                        if stager is not None:
+                            # pre-drawn samples were drawn for the OLD
+                            # partitions: drop them and rewind the
+                            # generator so the fresh serial draw below
+                            # reads what a never-staged run would
+                            stager.discard(rng)
                         members, keys = current, [k for k, _ in current]
                         parts = self._split_parts(split, members)
                         max_samples = max(len(p) for p in parts)
                         bcast.forget_missing(keys)  # rejoins start from full
-                        if use_stream:
+                        if use_stream or stager is not None:
                             # re-arm staging for the new membership; departed
                             # workers' streams were closed by unregister, and
                             # a (re)joined worker's stream re-opens lazily on
                             # its first dispatch below
                             bcast.stage_for(keys, fit_token, local_steps,
-                                            batch_size, learning_rate)
+                                            batch_size, learning_rate,
+                                            frames=use_stream)
                         # host-local workers absorb the new partition bounds
                         # themselves: ids outside a resident slice trigger the
                         # worker-side incremental reload (O(delta) rows through
@@ -1572,9 +1997,25 @@ class MasterNode:
                         decoder = None
                         if quorum is None:
                             grad_acc.fill(0.0)
-                            decoder = _ArrivalDecoder(grad_acc)
+                            decoder = _ArrivalDecoder(grad_acc, lanes=lanes)
+                        elif lanes:
+                            # quorum + lanes: parse-on-arrival only — the
+                            # contributor set (hedge wins, late originals)
+                            # is resolved at round close, where add_into
+                            # replays it in canonical order
+                            decoder = _ArrivalDecoder(grad_acc, lanes=lanes,
+                                                      defer=True)
+                        # pooled dispatch: round t's barrier already drew
+                        # these ids on the stage pool; a retry/resplit that
+                        # falsified the staging assumptions restored the
+                        # generator, and the serial draw below reads the
+                        # exact values a never-staged run would
+                        staged_ids = (stager.take(rng, keys, epoch, batch)
+                                      if stager is not None else None)
                         for (key, stub), part in zip(members, parts):
-                            ids = _draw_ids(rng, part, batch, window_span)
+                            ids = (staged_ids[key] if staged_ids is not None
+                                   else _draw_ids(rng, part, batch,
+                                                  window_span))
                             ids_by_key[key] = ids
                             frame = None
                             req = None
@@ -1584,8 +2025,11 @@ class MasterNode:
                                 # arm attached) during the previous barrier —
                                 # dispatch adds the sample draw and writes
                                 frame = bcast.take_staged_frame(key)
-                            if frame is not None:
-                                req = frame.request
+                                if frame is not None:
+                                    req = frame.request
+                            elif stager is not None:
+                                req = bcast.take_staged_request(key)
+                            if req is not None:
                                 req.samples.extend(ids.astype(np.int32))
                             else:
                                 if use_stream:
@@ -1611,6 +2055,14 @@ class MasterNode:
                             futs.append((key, fut))
                             if decoder is not None:
                                 decoder.watch(len(futs) - 1, fut)
+                        if (stager is not None
+                                and batch + window_span < max_samples):
+                            # overlap window: round t+1's draws run on the
+                            # stage pool while this round's replies are in
+                            # flight (epoch-final rounds stage nothing —
+                            # the next epoch re-keys the generator)
+                            stager.stage(rng, keys, parts, epoch,
+                                         batch + window_span, window_span)
                         if quorum is None:
                             # barrier, with deadlines; receive-side wire accounting
                             # happens per arriving reply inside _await_futures (send-
@@ -1700,7 +2152,16 @@ class MasterNode:
                         # contributors (own + hedge replies) and the mean over
                         # |contributors| is the unbiased 1/|ok| scaling of Chen
                         # et al. 2016's backup-worker rule.
-                        if decoder is None or decoder.decoded != len(replies):
+                        if decoder is not None and decoder.defer:
+                            # quorum + lanes: the contributor set is known
+                            # only now — accumulate it in canonical order,
+                            # reusing each reply's arrival-callback parse
+                            # (hedge replies parse here; the float adds
+                            # are decode_grad_into's exactly)
+                            grad_acc.fill(0.0)
+                            for reply in replies:
+                                decoder.add_into(reply, grad_acc)
+                        elif decoder is None or decoder.decoded != len(replies):
                             grad_acc.fill(0.0)
                             for reply in replies:
                                 codec.decode_grad_into(reply, grad_acc)
@@ -1715,8 +2176,14 @@ class MasterNode:
                                     staleness_s=time.perf_counter() - t_batch):
                                 wspan.set(health_tripped=True)
                                 if health.action in ("snapshot", "halt"):
+                                    # the stager may have pre-drawn the next
+                                    # round: persist the SERIAL state, or a
+                                    # resume would skip a round's draws
                                     _health_snapshot(
-                                        epoch, batch, rng.bit_generator.state, w)
+                                        epoch, batch,
+                                        stager.rng_state(rng)
+                                        if stager is not None
+                                        else rng.bit_generator.state, w)
                                 if health.action == "halt":
                                     halted = True
                                     break
@@ -1757,10 +2224,15 @@ class MasterNode:
                                 and rounds_since_save >= fit_state_every):
                             # window-cadence crash snapshot: the cursor points
                             # PAST the just-applied window, and the RNG state is
-                            # exactly what the next window will draw from
+                            # exactly what the next window will draw from — the
+                            # stager's serial-equivalent view when a pre-draw
+                            # is pending, so a resumed fit replays identically
                             save_fit_state(
                                 fit_state_path, weights=w, epoch=epoch,
-                                batch=batch, rng_state=rng.bit_generator.state,
+                                batch=batch,
+                                rng_state=stager.rng_state(rng)
+                                if stager is not None
+                                else rng.bit_generator.state,
                                 test_losses_nf=test_newest_first,
                                 opt_kind=opt_kind,
                                 opt_leaves=jax.tree_util.tree_leaves(opt_state)
@@ -1815,6 +2287,15 @@ class MasterNode:
         finally:
             if use_stream:
                 self._close_streams()
+            if stager is not None:
+                # a pending pre-draw dies with the fit (the epoch generator
+                # it would restore into is gone too); hit/discard tallies
+                # land once per fit
+                stager.close()
+                self.metrics.counter(
+                    metrics_mod.STAGE_HITS).increment(stager.hits)
+                self.metrics.counter(
+                    metrics_mod.STAGE_DISCARDS).increment(stager.discards)
 
         save_sync_fit_final(
             checkpointer, result.epochs_run, start_epoch, checkpoint_every,

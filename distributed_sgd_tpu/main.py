@@ -156,11 +156,13 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
         log.warning(
             "DSGD_COMPRESS=%s ignored: in-mesh engines have no wire path "
             "(use engine=rpc or async_mode=gossip)", cfg.compress)
-    if cfg.local_steps > 1 or cfg.delta_broadcast or cfg.stream:
+    if (cfg.local_steps > 1 or cfg.delta_broadcast or cfg.stream
+            or cfg.fanin_lanes or cfg.stage_pool):
         # the pipelined sync levers shape RPC wire traffic; the mesh
         # engines exchange gradients through XLA collectives
         log.warning(
-            "DSGD_LOCAL_STEPS/DSGD_DELTA_BROADCAST/DSGD_STREAM ignored: "
+            "DSGD_LOCAL_STEPS/DSGD_DELTA_BROADCAST/DSGD_STREAM/"
+            "DSGD_FANIN_LANES/DSGD_STAGE_POOL ignored: "
             "the pipelined sync engine is the rpc topology's (use "
             "engine=rpc; the mesh local-SGD equivalent is "
             "async_mode=local_sgd / sync_period)")
@@ -358,6 +360,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 local_steps=cfg.local_steps,
                 delta_broadcast=cfg.delta_broadcast,
                 stream=cfg.stream,
+                fanin_lanes=cfg.fanin_lanes, stage_pool=cfg.stage_pool,
                 quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
                 health=_health_monitor(cfg, metrics=c.master.metrics),
                 **_fit_state_args(cfg),
@@ -618,6 +621,10 @@ def _run_role(cfg: Config, role: str) -> None:
             # DSGD_SERVE_STATE: a restarted router re-pins the promoted
             # version instead of re-canarying it (docs/SERVING.md)
             state_path=cfg.serve_state,
+            # DSGD_SERVE_PROBE_REFRESH_S: rotate fresh held-out probe rows
+            # in from the probe file on a cadence (ROADMAP 3c)
+            probe_path=cfg.serve_probe,
+            probe_refresh_s=cfg.serve_probe_refresh_s,
         ).start()
         log.info("routing on :%d over %s (canary=%g, hedge=%gms)",
                  router.bound_port, cfg.serve_targets, cfg.serve_canary,
@@ -645,6 +652,8 @@ def _run_role(cfg: Config, role: str) -> None:
             telemetry_port=cfg.telemetry_port if cfg.telemetry else None,
             metrics=metrics_mod.global_metrics(), seed=cfg.seed,
             state_path=cfg.serve_state,
+            probe_path=cfg.serve_probe,
+            probe_refresh_s=cfg.serve_probe_refresh_s,
         ).start()
         log.info("serving fleet: router :%d over %d in-process replicas",
                  fleet.router_port, cfg.serve_replicas)
@@ -721,6 +730,7 @@ def _run_role(cfg: Config, role: str) -> None:
                     local_steps=cfg.local_steps,
                     delta_broadcast=cfg.delta_broadcast,
                     stream=cfg.stream,
+                    fanin_lanes=cfg.fanin_lanes, stage_pool=cfg.stage_pool,
                     quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
                     health=_health_monitor(cfg, metrics=master.metrics),
                     **_fit_state_args(cfg),

@@ -177,6 +177,53 @@ def apply_weight_delta(w: np.ndarray, delta: pb.WeightDelta) -> np.ndarray:
     return out
 
 
+def parse_grad(g: pb.GradUpdate):
+    """Materialize a GradUpdate's wire payload into ndarrays WITHOUT
+    touching any accumulator — the expensive half of `decode_grad_into`
+    (repeated-field -> numpy conversion, qint8 dequantization), split out
+    so the sharded fan-in lanes (core/master.py `_ArrivalDecoder`,
+    DSGD_FANIN_LANES) can run it concurrently across gRPC arrival
+    callbacks while the float ACCUMULATION stays strictly send-ordered
+    (and therefore bit-identical to the unsharded path).
+
+    Returns an opaque parsed form for `add_parsed`:
+      ('scatter', int64 indices, f32 values)  — sparse / topk arms
+      ('add', f32 vector)                     — dense (zero-copy
+                                                frombuffer view of the
+                                                proto bytes) / qint8
+      ('zero',)                               — empty coordinate list
+    """
+    which = g.WhichOneof("grad")
+    if which == "sparse" or (which == "compressed" and g.compressed.codec == "topk"):
+        src = g.sparse if which == "sparse" else g.compressed
+        if not len(src.indices):
+            return ("zero",)
+        return ("scatter", np.asarray(src.indices, dtype=np.int64),
+                np.asarray(src.values, dtype=np.float32))
+    if which == "compressed":
+        if g.compressed.codec != "qint8":
+            raise ValueError(
+                f"unknown CompressedGrad codec {g.compressed.codec!r}")
+        return ("add", _qint8_values(g.compressed))
+    return ("add", np.frombuffer(g.dense.data, dtype="<f4", count=g.dense.size))
+
+
+def add_parsed(parsed, out: np.ndarray, scale: float = 1.0) -> np.ndarray:
+    """Accumulate a `parse_grad` result into `out` — the float ops are
+    EXACTLY `decode_grad_into`'s (fancy-indexed `+=` over strictly unique
+    indices for coordinate forms, one vector `+=` for dense forms), so
+    parse-then-add is bit-identical to the fused decode whatever thread
+    ran the parse."""
+    kind = parsed[0]
+    if kind == "scatter":
+        vals = parsed[2]
+        out[parsed[1]] += vals * scale if scale != 1.0 else vals
+    elif kind == "add":
+        v = parsed[1]
+        out += v * scale if scale != 1.0 else v
+    return out
+
+
 def decode_grad_into(g: pb.GradUpdate, out: np.ndarray, scale: float = 1.0) -> np.ndarray:
     """Accumulate a GradUpdate into a caller-owned buffer: out += scale * g.
 
@@ -190,22 +237,8 @@ def decode_grad_into(g: pb.GradUpdate, out: np.ndarray, scale: float = 1.0) -> n
     topk support), which the fancy-indexed `+=` relies on.
 
     Equivalent to `out += scale * decode_grad(g)` up to float evaluation
-    order; returns `out` for chaining.
+    order; returns `out` for chaining.  Composed from `parse_grad` +
+    `add_parsed` so the sharded fan-in can split the two halves across
+    threads without a second decode implementation to drift.
     """
-    which = g.WhichOneof("grad")
-    if which == "sparse" or (which == "compressed" and g.compressed.codec == "topk"):
-        src = g.sparse if which == "sparse" else g.compressed
-        if len(src.indices):
-            vals = np.asarray(src.values, dtype=np.float32)
-            out[np.asarray(src.indices, dtype=np.int64)] += (
-                vals * scale if scale != 1.0 else vals)
-        return out
-    if which == "compressed":
-        if g.compressed.codec != "qint8":
-            raise ValueError(
-                f"unknown CompressedGrad codec {g.compressed.codec!r}")
-        v = _qint8_values(g.compressed)
-    else:
-        v = np.frombuffer(g.dense.data, dtype="<f4", count=g.dense.size)
-    out += v * scale if scale != 1.0 else v
-    return out
+    return add_parsed(parse_grad(g), out, scale)

@@ -72,25 +72,35 @@ class StreamRpcError(grpc.RpcError):
         return f"StreamRpcError({self._code}: {self._details})"
 
 
-class _Wheel:
-    """Shared frame-deadline enforcement: one lazy daemon thread settling
-    expired stream futures (heapq ordered by absolute deadline)."""
+class Wheel:
+    """Shared deadline wheel: one lazy daemon thread firing items at their
+    absolute (time.monotonic) deadline, heapq-ordered — one heap push per
+    watch, a wake-up only when the head moves earlier.
 
-    def __init__(self):
+    Grown out of the stream transport's frame-deadline enforcement and
+    now the ONE deadline scheduler shared with the master's liveness
+    plane (core/master.py `_heartbeat_loop`, docs/SCALING.md): an item is
+    either a plain callable (fired as `item()`) or a `_StreamFuture`-like
+    object exposing `_expire()`.  Items fire on the wheel thread — keep
+    them non-blocking (flip an event, push a deque entry); the wheel is a
+    scheduler, not a worker pool."""
+
+    def __init__(self, name: str = "deadline-wheel"):
+        self._name = name
         self._cv = threading.Condition()
         self._heap: list = []
         self._seq = 0
         self._running = False
 
-    def watch(self, deadline: float, fut: "_StreamFuture") -> None:
+    def watch(self, deadline: float, item) -> None:
         with self._cv:
             self._seq += 1
             head = self._heap[0][0] if self._heap else None
-            heapq.heappush(self._heap, (deadline, self._seq, fut))
+            heapq.heappush(self._heap, (deadline, self._seq, item))
             if not self._running:
                 self._running = True
                 threading.Thread(target=self._run, daemon=True,
-                                 name="fitstream-wheel").start()
+                                 name=self._name).start()
                 self._cv.notify()
             elif head is None or deadline < head:
                 # wake only when the head moved EARLIER: the hot path
@@ -106,19 +116,24 @@ class _Wheel:
                     if not self._cv.wait(timeout=5.0) and not self._heap:
                         self._running = False
                         return  # idle: die; the next watch() respawns
-                due, _, fut = self._heap[0]
+                due, _, item = self._heap[0]
                 now = time.monotonic()
                 if due > now:
                     self._cv.wait(timeout=due - now)
                     continue
                 heapq.heappop(self._heap)
             try:
-                fut._expire()
-            except Exception:  # noqa: BLE001 - one future must not kill the wheel
+                if callable(item):
+                    item()
+                else:
+                    item._expire()
+            except Exception:  # noqa: BLE001 - one item must not kill the wheel
                 pass
 
 
-_WHEEL = _Wheel()
+_Wheel = Wheel  # historical private name (pre-SCALING imports)
+
+_WHEEL = Wheel(name="fitstream-wheel")
 
 
 class _StreamFuture:

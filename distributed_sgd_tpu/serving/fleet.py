@@ -46,6 +46,8 @@ class ServingFleet:
         metrics=None,
         seed: int = 0,
         state_path: Optional[str] = None,
+        probe_path: Optional[str] = None,
+        probe_refresh_s: float = 0.0,
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -66,7 +68,8 @@ class ServingFleet:
             probe=probe, hedge_ms=hedge_ms, health_s=health_s,
             request_timeout_s=request_timeout_s,
             telemetry_port=telemetry_port, metrics=metrics, seed=seed,
-            state_path=state_path,
+            state_path=state_path, probe_path=probe_path,
+            probe_refresh_s=probe_refresh_s,
         )
 
     @property

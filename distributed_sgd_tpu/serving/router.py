@@ -182,9 +182,15 @@ class ServingRouter:
         telemetry_port: Optional[int] = None,
         seed: int = 0,
         state_path: Optional[str] = None,
+        probe_path: Optional[str] = None,
+        probe_refresh_s: float = 0.0,
     ):
         if not replicas:
             raise ValueError("a router needs at least one replica endpoint")
+        if probe_refresh_s > 0 and not probe_path:
+            raise ValueError(
+                "probe_refresh_s needs probe_path: the refresh re-reads "
+                "the probe file on its cadence")
         if not 0.0 <= canary_fraction <= 1.0:
             raise ValueError("canary_fraction must be in [0, 1]")
         if metrics is None:
@@ -205,6 +211,22 @@ class ServingRouter:
         self.canary_fraction = float(canary_fraction)
         self.canary_ratio = float(canary_ratio)
         self._probe = list(probe) if probe else None
+        # probe-set refresh (ROADMAP 3c, DSGD_SERVE_PROBE_REFRESH_S): with
+        # a cadence > 0 the health loop re-reads `probe_path` every
+        # refresh period (mtime-gated — an untouched file costs a stat)
+        # and rotates the fresh held-out rows in through refresh_probe(),
+        # re-anchoring the canary baseline on the PROMOTED version's loss
+        # over the new rows.  0 (default): fixed probe set, byte-identical
+        # canary behavior.
+        self._probe_path = probe_path
+        self._probe_refresh_s = max(0.0, float(probe_refresh_s))
+        self._probe_mtime: Optional[float] = None
+        self._probe_next_check = 0.0
+        if probe_path:
+            try:
+                self._probe_mtime = os.path.getmtime(probe_path)
+            except OSError:
+                self._probe_mtime = None
         self._model_name, self._lam = model, float(lam)
         self._probe_model = None  # built lazily (losses_from_margins only)
         self._promoted_version: Optional[int] = None
@@ -399,6 +421,70 @@ class ServingRouter:
     def _health_loop(self) -> None:
         while not self._stop.wait(self.health_s):
             self._health_pass()
+            if self._probe_refresh_s > 0:
+                self._maybe_refresh_probe()
+
+    # -- canary probe-set refresh (ROADMAP 3c; docs/SERVING.md) --------------
+
+    def refresh_probe(self, rows) -> None:
+        """Rotate a fresh held-out probe set in and re-anchor the canary
+        baseline (DSGD_SERVE_PROBE_REFRESH_S, docs/SERVING.md).
+
+        The old baseline was measured on the OLD rows — comparing a new
+        version's loss on the new rows against it would gate against an
+        apples-to-oranges number, so the PROMOTED version is re-evaluated
+        on the new rows (through the eligible replicas, exactly the
+        canary probe path) and becomes the new baseline via
+        `LossChecker.refresh`.  If the promoted version cannot be probed
+        right now (no replica answered), the checker goes baseline-less
+        and the next canary pass seeds it — a long-running fleet's gate
+        tracks live traffic instead of fossilizing on the rows it started
+        with.  Rejected versions STAY rejected: rejection was a verdict
+        against the fleet state at the time, and un-rejecting on a probe
+        rotation would re-open every previously failed version at once."""
+        rows = list(rows)
+        if not rows:
+            raise ValueError("refresh_probe needs a non-empty probe set")
+        with self._push_lock:
+            self._probe = rows
+            loss = None
+            if self._promoted_version is not None:
+                loss = self._probe_loss(self._eligible() or self._replicas,
+                                        self._promoted_version)
+            self._checker.refresh(best_loss=loss)
+            if loss is not None and np.isfinite(loss):
+                self.metrics.gauge(metrics_mod.ROUTER_CANARY_LOSS).set(loss)
+            self.metrics.counter(
+                metrics_mod.ROUTER_PROBE_REFRESH).increment()
+            self._persist_state()
+        log.info(
+            "canary probe set refreshed (%d rows): baseline re-anchored to "
+            "%s", len(rows),
+            f"promoted v{self._promoted_version} loss {loss:.6f}"
+            if loss is not None else "none (next canary pass seeds it)")
+
+    def _maybe_refresh_probe(self) -> None:
+        """Health-loop tick: re-read `probe_path` once per refresh period,
+        rotating it in only when the file actually changed (mtime)."""
+        now = time.monotonic()
+        if now < self._probe_next_check:
+            return
+        self._probe_next_check = now + self._probe_refresh_s
+        try:
+            mtime = os.path.getmtime(self._probe_path)
+        except OSError:
+            return  # rotated away mid-write / not there yet: next period
+        if self._probe_mtime is not None and mtime <= self._probe_mtime:
+            return
+        # record the mtime up front so a persistently bad file is warned
+        # about ONCE per rewrite, not re-parsed and re-warned every period
+        self._probe_mtime = mtime
+        try:
+            rows = load_probe(self._probe_path)
+            self.refresh_probe(rows)
+        except Exception as e:  # noqa: BLE001 - a bad file must not kill health
+            log.warning("probe refresh from %s failed: %s",
+                        self._probe_path, e)
 
     # -- checkpoint distribution + canary (PushWeights) ----------------------
 

@@ -372,6 +372,19 @@ SYNC_BCAST_DELTA = "master.sync.bcast.delta"   # counter: sparse WeightDelta sen
 SYNC_BCAST_CACHED = "master.sync.bcast.cached" # counter: header-only sends (0 bytes)
 SYNC_STALE = "master.sync.bcast.stale"         # counter: stale replies -> full fallback
 
+# -- O(N) master plane (DSGD_FANIN_LANES / DSGD_STAGE_POOL; docs/SCALING.md) --
+#
+# Pooled-dispatch staging instruments (core/master.py _DispatchStager):
+# `hits` counts rounds dispatched from a pre-staged draw, `discards`
+# rounds whose staging assumptions moved (retry, resplit) and fell back
+# to the serial draw with the generator state restored.  Liveness-plane
+# evictions get a first-class counter (the soak bench's zero-evictions
+# gate reads it; the flight recorder keeps the per-worker evidence).
+# Knobs off, none of these registers (asserted by tests/test_fanin_lanes).
+STAGE_HITS = "master.sync.stage.hits"          # counter: rounds served pre-staged
+STAGE_DISCARDS = "master.sync.stage.discards"  # counter: stages dropped (retry/resplit)
+MASTER_EVICTIONS = "master.evictions"          # counter: involuntary unregisters
+
 
 def record_broadcast(metrics: "Metrics", form: str, n_bytes: int) -> None:
     """Account one master->worker weight send: `form` is 'full' | 'delta' |
@@ -484,6 +497,7 @@ ROUTER_ELIGIBLE = "router.replica.eligible"          # gauge: replicas in rotati
 ROUTER_CANARY_PROMOTED = "router.canary.promoted"    # counter: versions promoted fleet-wide
 ROUTER_CANARY_ROLLBACK = "router.canary.rollback"    # counter: versions rolled back
 ROUTER_CANARY_LOSS = "router.canary.probe_loss"      # gauge: last probe-set loss
+ROUTER_PROBE_REFRESH = "router.canary.probe_refresh"  # counter: probe-set rotations
 
 
 def record_push(metrics: "Metrics", form: str, wire_bytes: int,

@@ -1,0 +1,410 @@
+"""O(N) master plane (ISSUE 15, docs/SCALING.md): sharded fan-in decode
+lanes + pooled dispatch staging.
+
+Correctness story under test: the lanes shard the PARSE, never the SUM —
+the float accumulation stays one send-ordered f32 chain, so lanes-on
+weights are byte-identical to the single-accumulator path whatever the
+arrival order, across plain sync, quorum+hedge, retry, and compressed
+(top-k EF) rounds; the dispatch stager consumes the epoch sample stream
+in exactly the serial order (retry/resplit discards restore the
+generator); the lane count is pinned per fit; and with both knobs off
+the stage plane never registers an instrument.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.core.master import (
+    _ArrivalDecoder,
+    _DispatchStager,
+    _draw_ids,
+)
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.utils import metrics as mm
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(
+        rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=51,
+                  idf_values=True))
+
+
+@pytest.fixture(scope="module")
+def model_fn(data):
+    train, _ = data
+    ds = dim_sparsity(train)
+    return lambda: make_model("hinge", 1e-5, train.n_features,
+                              dim_sparsity=ds)
+
+
+class _SettleLaterFut:
+    """Future-alike settled by the test, firing callbacks like gRPC."""
+
+    def __init__(self):
+        self._cbs = []
+        self._done = False
+        self._result = None
+        self._exc = None
+
+    def add_done_callback(self, cb):
+        if self._done:
+            cb(self)
+        else:
+            self._cbs.append(cb)
+
+    def settle(self, result=None, exc=None):
+        self._result, self._exc, self._done = result, exc, True
+        for cb in self._cbs:
+            cb(self)
+
+    def done(self):
+        return self._done
+
+    def result(self):
+        if not self._done:
+            raise AssertionError("result() before settle()")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+def _mixed_replies(n: int, dim: int = 96):
+    """n GradUpdates cycling every wire arm (dense / sparse / topk /
+    qint8) with overlapping support — the adversarial case for any
+    accumulation regrouping."""
+    rng = np.random.default_rng(7)
+    out = []
+    for i in range(n):
+        v = rng.normal(size=dim).astype(np.float32)
+        arm = i % 4
+        if arm == 0:
+            out.append(pb.GradUpdate(dense=codec.encode_tensor(v)))
+        elif arm == 1:
+            v[rng.random(dim) < 0.6] = 0.0
+            out.append(codec.encode_grad(v, sparse_threshold=1.0))
+        elif arm == 2:
+            keep = np.argsort(-np.abs(v))[: dim // 4].astype(np.int32)
+            out.append(codec.encode_topk(np.sort(keep), v[np.sort(keep)], dim))
+        else:
+            out.append(codec.quantize_qint8(v, np.random.default_rng(i)))
+    return out
+
+
+# -- decoder unit: N=32 virtual workers, every lane count ---------------------
+
+
+def test_lanes_byte_identical_to_single_accumulator_any_arrival_order():
+    """32 mixed-arm replies settling in a scrambled order must land the
+    SAME accumulator bytes for lanes in {1, 2, 4, 7} as the lanes=0
+    single-lock decoder — the send-ordered f32 chain is shared, only the
+    parse is sharded."""
+    dim = 96
+    replies = _mixed_replies(32, dim)
+    arrival = np.random.default_rng(3).permutation(32)
+
+    def run(lanes: int) -> np.ndarray:
+        acc = np.zeros(dim, dtype=np.float32)
+        dec = _ArrivalDecoder(acc, lanes=lanes)
+        futs = [(("w", i), _SettleLaterFut()) for i in range(32)]
+        for i, (_k, f) in enumerate(futs):
+            dec.watch(i, f)
+        for i in arrival:
+            futs[i][1].settle(replies[i])
+        assert dec.finish(futs)
+        assert dec.decoded == 32
+        return acc
+
+    want = run(0)
+    for lanes in (1, 2, 4, 7):
+        got = run(lanes)
+        assert got.tobytes() == want.tobytes(), (
+            f"lanes={lanes} drifted from the single-accumulator decode")
+
+
+def test_lanes_failure_and_stale_freeze_like_single_lock():
+    """A failed or stale reply must freeze the sharded cursor exactly like
+    the legacy decoder: nothing past it accumulates, finish() reports
+    dirty, and the window retries on a re-zeroed accumulator."""
+    for bad in (None, pb.GradUpdate(stale_version=True)):
+        acc = np.zeros(8, dtype=np.float32)
+        dec = _ArrivalDecoder(acc, lanes=3)
+        futs = [(("w", i), _SettleLaterFut()) for i in range(4)]
+        for i, (_k, f) in enumerate(futs):
+            dec.watch(i, f)
+        futs[0][1].settle(codec.encode_grad(np.ones(8, dtype=np.float32)))
+        if bad is None:
+            futs[1][1].settle(exc=RuntimeError("deadline"))
+        else:
+            futs[1][1].settle(bad)
+        futs[2][1].settle(codec.encode_grad(2 * np.ones(8, dtype=np.float32)))
+        futs[3][1].settle(codec.encode_grad(3 * np.ones(8, dtype=np.float32)))
+        assert not dec.finish(futs)
+        assert dec.decoded == 1  # only the clean prefix before the freeze
+
+
+def test_lanes_set_once_survives_lagging_callbacks():
+    """A callback that fires after finish() already drained its slot must
+    not decode the reply twice (per-lane set-once)."""
+
+    class _NoCallbackFut(_SettleLaterFut):
+        def add_done_callback(self, cb):
+            self._late_cb = cb
+
+    acc = np.zeros(4, dtype=np.float32)
+    dec = _ArrivalDecoder(acc, lanes=2)
+    fut = _NoCallbackFut()
+    dec.watch(0, fut)
+    fut.settle(codec.encode_grad(np.asarray([1, 2, 3, 4], np.float32)))
+    assert dec.finish([(("w", 0), fut)])
+    np.testing.assert_array_equal(acc, [1, 2, 3, 4])
+    fut._late_cb(fut)
+    np.testing.assert_array_equal(acc, [1, 2, 3, 4])
+
+
+def test_defer_mode_reuses_arrival_parse_and_matches_fused_decode():
+    """Quorum's parse-only mode: add_into over an arbitrary contributor
+    subset (arrival parses reused, unwatched hedge replies parsed on the
+    spot) must equal decode_grad_into over the same subset, bit for bit."""
+    dim = 96
+    replies = _mixed_replies(12, dim)
+    acc = np.zeros(dim, dtype=np.float32)
+    dec = _ArrivalDecoder(acc, lanes=4, defer=True)
+    futs = [_SettleLaterFut() for _ in range(8)]  # 8 watched, 4 hedges
+    for i, f in enumerate(futs):
+        dec.watch(i, f)
+        f.settle(replies[i])
+    assert dec.parsed == 8
+    contributors = [replies[i] for i in (5, 0, 9, 3, 11, 6)]
+    got = np.zeros(dim, dtype=np.float32)
+    for r in contributors:
+        dec.add_into(r, got)
+    want = np.zeros(dim, dtype=np.float32)
+    for r in contributors:
+        codec.decode_grad_into(r, want)
+    assert got.tobytes() == want.tobytes()
+    np.testing.assert_array_equal(acc, np.zeros(dim, np.float32))  # defer never touches acc
+
+
+def test_parse_then_add_is_decode_grad_into(    ):
+    """codec.parse_grad + add_parsed must be the fused decode exactly,
+    for every wire arm."""
+    for g in _mixed_replies(8, 64):
+        a = np.zeros(64, np.float32)
+        b = np.zeros(64, np.float32)
+        codec.decode_grad_into(g, a)
+        codec.add_parsed(codec.parse_grad(g), b)
+        assert a.tobytes() == b.tobytes()
+
+
+# -- dispatch stager: serial sample-stream equivalence ------------------------
+
+
+def test_stager_take_matches_serial_draws_and_discard_restores():
+    parts = [np.arange(100) + 100 * i for i in range(4)]
+    keys = [("w", i) for i in range(4)]
+
+    def serial(n_rounds):
+        rng = np.random.default_rng((0, 0))
+        return [[_draw_ids(rng, p, r * 8, 8) for p in parts]
+                for r in range(n_rounds)]
+
+    want = serial(3)
+    rng = np.random.default_rng((0, 0))
+    stager = _DispatchStager(2)
+    try:
+        got0 = [_draw_ids(rng, p, 0, 8) for p in parts]  # round 0 serial
+        stager.stage(rng, keys, parts, epoch=0, cursor=8, span=8)
+        taken = stager.take(rng, keys, 0, 8)
+        assert taken is not None
+        got1 = [taken[k] for k in keys]
+        # round 2 staged but DISCARDED (cursor mismatch models a retry):
+        # the generator must rewind so the serial draw reads the same ids
+        stager.stage(rng, keys, parts, epoch=0, cursor=16, span=8)
+        assert stager.take(rng, keys, 0, 99) is None
+        got2 = [_draw_ids(rng, p, 16, 8) for p in parts]
+        for got, exp in zip((got0, got1, got2), want):
+            for a, b in zip(got, exp):
+                np.testing.assert_array_equal(a, b)
+        assert stager.hits == 1 and stager.discards == 1
+    finally:
+        stager.close()
+
+
+def test_stager_snapshot_state_is_the_serial_state():
+    """While a pre-draw is pending, rng_state() must report the state a
+    serial run would persist — resuming from the raw state would skip a
+    round's draws."""
+    parts = [np.arange(64)]
+    rng = np.random.default_rng((0, 1))
+    ref = np.random.default_rng((0, 1))
+    _draw_ids(rng, parts[0], 0, 8)
+    _draw_ids(ref, parts[0], 0, 8)
+    serial_state = ref.bit_generator.state
+    stager = _DispatchStager(1)
+    try:
+        stager.stage(rng, [("w", 0)], parts, epoch=0, cursor=8, span=8)
+        assert stager.rng_state(rng) == serial_state
+        assert stager.take(rng, [("w", 0)], 0, 8) is not None
+        # nothing pending: the live state IS the serial state again
+        ref_next = _draw_ids(ref, parts[0], 8, 8)
+        assert stager.rng_state(rng) == ref.bit_generator.state
+        del ref_next
+    finally:
+        stager.close()
+
+
+# -- end to end: lanes+pool byte-identity across round shapes -----------------
+
+
+def _fit(cluster, **kw):
+    kw.setdefault("max_epochs", 2)
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("learning_rate", 0.5)
+    return cluster.master.fit_sync(**kw)
+
+
+def _paired_runs(model_fn, train, test, n_workers=3, cluster_kw=None,
+                 seed=0, **fit_kw):
+    """The same fit with the O(N) plane off, then on (lanes=3 + pool=2);
+    returns (weights_off, weights_on)."""
+    out = []
+    for scaled in (False, True):
+        with DevCluster(model_fn(), train, test, n_workers=n_workers,
+                        seed=seed, **(cluster_kw or {})) as c:
+            kw = dict(fit_kw)
+            if scaled:
+                kw.update(fanin_lanes=3, stage_pool=2)
+            res = _fit(c, **kw)
+            out.append(np.asarray(res.state.weights))
+    return out
+
+
+def test_e2e_sync_lanes_pool_byte_identical(data, model_fn):
+    train, test = data
+    off, on = _paired_runs(model_fn, train, test)
+    assert np.array_equal(off, on)
+    assert np.any(off != 0)
+
+
+def test_e2e_compressed_topk_ef_rounds_byte_identical(data, model_fn):
+    """Top-k EF replies make the accumulation support-sparse and
+    worker-stateful — the adversarial case for any decode reordering."""
+    train, test = data
+    off, on = _paired_runs(
+        model_fn, train, test,
+        cluster_kw=dict(compress="topk", compress_k=0.05, compress_ef=True))
+    assert np.array_equal(off, on)
+
+
+def test_e2e_retry_rounds_byte_identical(data, model_fn):
+    """A worker that fails one Gradient forces a window retry: the retry
+    must redraw the SAME ids lanes-on as lanes-off (the stager restores
+    the generator), landing identical weights."""
+    train, test = data
+
+    def run(scaled: bool):
+        with DevCluster(model_fn(), train, test, n_workers=3, seed=0) as c:
+            victim = c.workers[1]
+            orig = victim.compute_gradient
+            fired = []
+
+            def fail_once(w, ids):
+                if not fired:
+                    fired.append(1)
+                    raise RuntimeError("injected one-shot gradient failure")
+                return orig(w, ids)
+
+            victim.compute_gradient = fail_once
+            kw = dict(grad_retries=3)
+            if scaled:
+                kw.update(fanin_lanes=3, stage_pool=2)
+            res = _fit(c, **kw)
+            assert fired, "the injected failure never fired"
+            assert len(c.master._workers) == 3, "retry must not evict"
+            return np.asarray(res.state.weights)
+
+    assert np.array_equal(run(False), run(True))
+
+
+def test_e2e_quorum_hedge_rounds_byte_identical(data, model_fn):
+    """Quorum + a deterministic straggler (one worker sleeps through the
+    soft deadline on every window of epoch 0): the hedged rounds must
+    land identical weights lanes-on vs lanes-off — the defer-mode decode
+    replays the same canonical contributor order."""
+    train, test = data
+
+    def run(scaled: bool):
+        with DevCluster(model_fn(), train, test, n_workers=3, seed=0) as c:
+            slowpoke = c.workers[2]
+            orig = slowpoke.compute_gradient
+            calls = []
+
+            def slow(w, ids):
+                calls.append(1)
+                if len(calls) <= 2:  # straggle the first two windows
+                    time.sleep(1.2)
+                return orig(w, ids)
+
+            # prewarm every worker so compile latency can't smear the
+            # deterministic straggle pattern
+            zeros = np.zeros(train.n_features, dtype=np.float32)
+            for w in c.workers:
+                w.compute_gradient(zeros, np.arange(16, dtype=np.int64))
+            slowpoke.compute_gradient = slow
+            kw = dict(quorum=2, straggler_soft_s=0.25, grad_timeout_s=10.0)
+            if scaled:
+                kw.update(fanin_lanes=3, stage_pool=2)
+            res = _fit(c, **kw)
+            assert len(c.master._workers) == 3, "a straggler is not dead"
+            return np.asarray(res.state.weights)
+
+    g = mm.global_metrics()
+    h0 = g.counter(mm.QUORUM_HEDGES).value
+    w_off = run(False)
+    assert g.counter(mm.QUORUM_HEDGES).value > h0, (
+        "the straggler never triggered a hedge — the test proved nothing")
+    w_on = run(True)
+    assert np.array_equal(w_off, w_on)
+
+
+def test_lane_count_change_mid_fit_refuses(data, model_fn):
+    """The lane layout is pinned at fit start: flipping the master's
+    fanin_lanes attribute mid-fit must raise, not silently re-shard."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        c.master.fanin_lanes = 2
+        flipped = []
+        orig_members = c.master._members
+
+        def flip_then_members():
+            if not flipped:
+                flipped.append(1)
+            elif c.master.fanin_lanes == 2 and len(flipped) > 4:
+                c.master.fanin_lanes = 5
+            else:
+                flipped.append(1)
+            return orig_members()
+
+        c.master._members = flip_then_members
+        with pytest.raises(RuntimeError, match="lane count changed"):
+            _fit(c, max_epochs=4)
+
+
+def test_knobs_off_stage_plane_never_registers(data, model_fn):
+    """A default-config fit must leave the stage instruments unregistered
+    in the master's registry — the knobs-off call graph never touches
+    the stage plane (the counter gate the scale bench also asserts)."""
+    train, test = data
+    metrics = mm.Metrics()
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        c.master.metrics = metrics
+        _fit(c, max_epochs=1)
+    assert mm.STAGE_HITS not in metrics._counters
+    assert mm.STAGE_DISCARDS not in metrics._counters

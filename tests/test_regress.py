@@ -268,3 +268,21 @@ def test_rounds_per_s_is_a_throughput_class_not_a_timing():
         {"metric": "rpc_sync_pipeline_smoke", "stream_rounds_per_s": 990.0},
         hist, tolerance=0.35)
     assert ok == []
+
+
+def test_scale_eff_is_a_higher_is_better_class():
+    """Scaling efficiency (`*_scale_eff`, benches/bench_scale.py) gates UP
+    with its own class band: a flattening collapse (the master going
+    serial-in-N again) regresses, a flatter curve never does."""
+    assert regress.direction("n32_scale_eff") == "up"
+    assert regress.tolerance_for("n32_scale_eff") == 0.35
+    hist = [{"metric": "scale_full", "n32_scale_eff": 0.30}] * 3
+    regs, lines = regress.check(
+        {"metric": "scale_full", "n32_scale_eff": 0.10}, hist,
+        tolerance=0.35)
+    assert regs == ["n32_scale_eff"]
+    assert any("[up," in ln for ln in lines)
+    ok, _ = regress.check(
+        {"metric": "scale_full", "n32_scale_eff": 0.90}, hist,
+        tolerance=0.35)
+    assert ok == []

@@ -653,3 +653,101 @@ def test_router_telemetry_endpoint_shows_per_replica_series(fleet):
         assert "serve_model_version" in served
     finally:
         exporter.stop()
+
+
+# -- canary probe-set refresh (ROADMAP 3c; DSGD_SERVE_PROBE_REFRESH_S) --------
+
+
+def test_probe_refresh_reanchors_baseline_and_promotes_after_drift(tmp_path):
+    """A long-running fleet's traffic drifts away from the probe rows it
+    started with: a version trained for the NEW distribution scores badly
+    on the stale probe and would be rolled back forever.  refresh_probe
+    rotates fresh held-out rows in and re-anchors the baseline on the
+    PROMOTED version's loss over them — after which the drift-adapted
+    version promotes, while versions rejected before the refresh stay
+    rejected."""
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    rng = np.random.default_rng(13)
+    w_a = rng.normal(size=64).astype(np.float32)
+    w_a[w_a == 0] = 0.1
+    w_b = -w_a  # the "drifted" optimum: scores ~2.0 on probe A, ~0 on B
+    _save(tmp_path, 1, w_a)
+    m = Metrics()
+    with ServingFleet(str(tmp_path), n_replicas=3, ckpt_poll_s=30.0,
+                      health_s=0.5, canary_fraction=0.34,
+                      probe=_probe_rows(w_a), metrics=m) as f:
+        pusher = WeightPusher([("127.0.0.1", f.router_port)],
+                              metrics=Metrics())
+        w2 = w_a.copy()
+        w2[0] *= 1.0 + 1e-3
+        assert pusher.push(2, w2) == 1  # baseline ~0 on probe A
+        # the drift-adapted weights are REJECTED against the stale probe
+        assert pusher.push(3, w_b) == 0
+        assert m.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1
+
+        # operator rotates fresh held-out rows in: the promoted version
+        # (~w_a) scores ~2.0 on probe B, and THAT becomes the baseline
+        f.router.refresh_probe(_probe_rows(w_b))
+        assert m.counter(mm.ROUTER_PROBE_REFRESH).value == 1
+        assert f.router._checker.best_loss > 1.0
+
+        # pre-refresh rejections are verdicts: v3 stays rejected...
+        assert pusher.push(3, w_b) == 0
+        assert m.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1  # no re-canary
+        # ...but a FRESH drift-adapted version now promotes (loss ~0 on B
+        # beats the re-anchored ~2.0 baseline)
+        w4 = w_b.copy()
+        w4[1] *= 1.0 + 1e-3
+        assert pusher.push(4, w4) == 1
+        assert m.counter(mm.ROUTER_CANARY_PROMOTED).value >= 2
+        for r in f.replicas:
+            assert r.store.step == 4
+        pusher.close()
+
+
+def test_probe_refresh_cadence_rereads_the_probe_file(tmp_path):
+    """The DSGD_SERVE_PROBE_REFRESH_S plumbing: the health loop re-reads
+    the probe .npz on its cadence and rotates it in only when the file's
+    mtime moved (deterministic here: the period is forced due and the
+    mtime bumped explicitly)."""
+    import os
+
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+
+    def _probe_npz(path, w, n=6):
+        idx = np.zeros((n, 2), np.int32)
+        val = np.zeros((n, 2), np.float32)
+        y = np.zeros(n, np.float32)
+        for i in range(n):
+            idx[i, 0], val[i, 0] = i, 1.0
+            y[i] = float(-np.sign(w[i]) or 1.0)
+        np.savez(path, indices=idx, values=val, labels=y)
+
+    rng = np.random.default_rng(17)
+    w_a = rng.normal(size=64).astype(np.float32)
+    w_a[w_a == 0] = 0.1
+    _save(tmp_path / "ckpt", 1, w_a)
+    probe_file = tmp_path / "probe.npz"
+    _probe_npz(probe_file, w_a)
+    m = Metrics()
+    with ServingFleet(str(tmp_path / "ckpt"), n_replicas=2, ckpt_poll_s=30.0,
+                      health_s=30.0, canary_fraction=0.5,
+                      probe=_probe_rows(w_a), metrics=m,
+                      probe_path=str(probe_file),
+                      probe_refresh_s=0.01) as f:
+        router = f.router
+        # unchanged file: the due period passes but the mtime gate holds
+        router._probe_next_check = 0.0
+        before = list(router._probe)
+        router._maybe_refresh_probe()
+        assert m.counter(mm.ROUTER_PROBE_REFRESH).value == 0
+        assert router._probe == before
+        # rotated file (mtime forced forward): the next due tick swaps it
+        _probe_npz(probe_file, -w_a)
+        os.utime(probe_file, (time.time() + 5, time.time() + 5))
+        router._probe_next_check = 0.0
+        router._maybe_refresh_probe()
+        assert m.counter(mm.ROUTER_PROBE_REFRESH).value == 1
+        assert router._probe != before
