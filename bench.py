@@ -408,6 +408,18 @@ def main() -> None:
 
         bench_soak.main(smoke="--smoke" in sys.argv)
         return
+    if "--flywheel" in sys.argv:
+        # continual-learning flywheel gate (docs/CONTINUAL.md): train +
+        # serve + live-probe-sourced drift detection + hands-free retrain
+        # -> canary -> promote, with a distribution shift injected
+        # mid-pump — hard-asserts recovery within the round budget, zero
+        # dropped Predicts, zero operator actions, and a bounded process
+        # leak slope.  --smoke is the CI-sized mode (runs the training
+        # plane under a named chaos scenario besides).
+        from benches import bench_flywheel
+
+        bench_flywheel.main(smoke="--smoke" in sys.argv)
+        return
     if "--chaos" in sys.argv:
         # chaos gate (docs/FAULT_TOLERANCE.md): sync training under the
         # canonical seeded fault plan, quorum on vs off — asserts

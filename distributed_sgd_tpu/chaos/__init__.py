@@ -33,6 +33,12 @@ Plan syntax (DSGD_CHAOS):
 - ``grace=D``      no faults for the first D after install (lets a
                    cluster form before the weather starts; `arm()`
                    resets the clock explicitly instead)
+- ``scope=named``  blast radius: faults apply only to edges that touch a
+                   NAMED endpoint (see `name_endpoint`; DevCluster names
+                   its master/workers) — un-named planes (a serving
+                   fleet, a bench load generator) run clear.  Default
+                   ``scope=all``.  A ``scenario:NAME`` spec accepts
+                   trailing overrides: ``scenario:flaky-rack;scope=named``
 
 Durations accept ``20ms``, ``1.5s``, or bare seconds.  Determinism: each
 (origin, target, method) edge draws from its own `random.Random` stream
@@ -89,6 +95,7 @@ class FaultPlan:
     error: float = 0.0
     grace_s: float = 0.0
     partitions: Tuple[Partition, ...] = ()
+    scope: str = "all"
 
     def __post_init__(self):
         for name in ("drop", "dup", "error"):
@@ -97,10 +104,58 @@ class FaultPlan:
                 raise ValueError(f"chaos {name}={p} must be a probability")
         if self.delay is not None and not (0 <= self.delay[0] <= self.delay[1]):
             raise ValueError(f"chaos delay range {self.delay} must be 0 <= lo <= hi")
+        if self.scope not in ("all", "named"):
+            raise ValueError(
+                f"chaos scope={self.scope!r} must be 'all' or 'named'")
+
+
+# -- named scenario library (ROADMAP 3c; DSGD_CHAOS=scenario:NAME) -----------
+# The soak bench's weather, promoted to named seeded plans so a bench run,
+# a bug report, and a CI job all mean the SAME faults when they say
+# "flaky-rack".  Each value is a full plan-grammar spec (seed included —
+# naming a scenario pins its randomness), resolved by parse_plan before
+# parsing, so config validation and every install path accept the names.
+SCENARIOS: Dict[str, str] = {
+    # lossy ToR switch: steady low drop + jittery small delays + the
+    # occasional duplicated frame, no partitions — transport noise only
+    "flaky-rack": "seed=23;drop=0.03;delay=2ms~20ms;dup=0.02",
+    # one slow device in the I/O path: long-tail delays with a grace
+    # window so startup traffic clears before the weather starts
+    "slow-disk": "seed=31;delay=10ms~150ms;grace=2s",
+    # asymmetric partition: two workers black-holed at different,
+    # non-overlapping times, riding steady transport noise — the
+    # quorum/hedge plane's worst weather.  Windows are sized to be
+    # absorbable by a correctly-budgeted deployment (heartbeat budget
+    # > 1.5s, quorum slack >= 1, and only one worker dark at a time)
+    "asym-partition": "seed=47;drop=0.02;delay=3ms~15ms;dup=0.01;"
+                      "partition=w1:1.5s@6s,w2:1.5s@9s",
+    # correlated blip then mass rejoin: three workers vanish TOGETHER and
+    # return together — the re-registration/resplit thundering herd
+    "thundering-rejoin": "seed=59;drop=0.02;delay=1ms~10ms;"
+                         "partition=w1:2s@3s,w2:2s@3s,w3:2s@3s",
+}
+
+
+def resolve_scenario(spec: str) -> str:
+    """Expand a ``scenario:NAME`` spec to its plan string; pass anything
+    else through untouched.  Tokens after the name override/extend the
+    scenario (``scenario:flaky-rack;scope=named``) — the seeded weather
+    stays the library's, the caller adjusts only its blast radius."""
+    if not spec.startswith("scenario:"):
+        return spec
+    name, _, extra = spec[len("scenario:"):].partition(";")
+    name = name.strip()
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown chaos scenario {name!r}; known: "
+            f"{', '.join(sorted(SCENARIOS))}")
+    return SCENARIOS[name] + (f";{extra}" if extra else "")
 
 
 def parse_plan(spec: str) -> FaultPlan:
-    """DSGD_CHAOS spec string -> FaultPlan (raises ValueError on typos)."""
+    """DSGD_CHAOS spec string -> FaultPlan (raises ValueError on typos).
+    Accepts ``scenario:NAME`` for the named seeded library above."""
+    spec = resolve_scenario(spec)
     kw: Dict[str, object] = {}
     parts: List[Partition] = []
     for token in filter(None, (t.strip() for t in spec.split(";"))):
@@ -118,6 +173,8 @@ def parse_plan(spec: str) -> FaultPlan:
             kw["delay"] = (a, b)
         elif key == "grace":
             kw["grace_s"] = _parse_duration(val)
+        elif key == "scope":
+            kw["scope"] = val
         elif key == "partition":
             for p in filter(None, (s.strip() for s in val.split(","))):
                 name, _, window = p.rpartition(":")
@@ -349,6 +406,17 @@ class ChaosState:
     def active(self) -> bool:
         return self._t0 is not None and self.elapsed() >= self.plan.grace_s
 
+    def in_scope(self, origin, target) -> bool:
+        """scope=named confines the weather to edges touching a named
+        endpoint (the plane the caller registered via `name_endpoint`);
+        every other edge — a serving fleet, a bench load generator
+        sharing the process — runs clear."""
+        if self.plan.scope == "all":
+            return True
+        with self._lock:
+            return any(ep in self._names
+                       for ep in (origin, target) if ep is not None)
+
     def _canonical(self, endpoint) -> Optional[str]:
         """Stable edge identity: the registered name when one exists
         (DevCluster: master/w0..wN — OS-assigned ports differ every run,
@@ -405,7 +473,7 @@ class _ChaosCallable:
         One uniform draw per candidate fault keeps the stream deterministic
         even as the plan's probabilities change."""
         st = self._state
-        if not st.active():
+        if not st.active() or not st.in_scope(self._origin, self._target):
             return ("pass", None)
         rng = st.rng(self._origin, self._target, self._method)
         # draws happen in a FIXED order so the stream replays

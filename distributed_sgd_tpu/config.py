@@ -291,6 +291,50 @@ class Config:
     # set and baseline are fixed at fleet start, byte-identical behavior.
     serve_probe_refresh_s: float = 0.0
 
+    # -- continual-learning autopilot (autopilot/; docs/CONTINUAL.md) -------
+    # All default-off: with DSGD_AUTOPILOT unset no autopilot thread
+    # starts, no reservoir attaches to the router, no new instrument
+    # registers, and serving wire + training weights stay byte-identical
+    # (asserted by tests/test_flywheel.py).
+    # master-of-switch: arm the flywheel.  dev role runs the full loop
+    # (probe sourcing + drift detection + warm-start retrain through the
+    # canary gate); route role attaches probe sourcing + the refresh
+    # cadence to the router (the drift SIGNAL, readable over /metrics);
+    # master role makes the retrain entry available.  serve/worker roles
+    # have no flywheel half and reject the knob at construction.
+    autopilot: bool = False
+    autopilot_poll_s: float = 1.0  # controller probe-loss poll period
+    autopilot_cooldown_s: float = 5.0  # post-verdict settle before re-arming
+    # drift rule (controller.DriftDetector, the HealthMonitor shape):
+    # EWMA(probe loss) > max(ratio * baseline, baseline + floor) for
+    # `patience` consecutive refreshes after `warmup` — the floor keeps
+    # the bounded-probe sampling noise (a capacity-row mean quantizes
+    # loss in 1/capacity steps) from ever clearing the ratio bar when
+    # the baseline lands near zero
+    autopilot_drift_ratio: float = 1.5
+    autopilot_drift_patience: int = 2
+    autopilot_drift_warmup: int = 4
+    autopilot_drift_floor: float = 0.1
+    # retrain window: the newest N stream rows the warm-start fit trains
+    # on (autopilot/stream.window_split — "the current distribution")
+    autopilot_window: int = 4096
+    autopilot_max_retrains: int = 0  # 0 = unbounded; N caps the flywheel
+    autopilot_canary_timeout_s: float = 120.0  # verdict wait before giving up
+    # residual settling: after a promotion re-anchors the detector, keep
+    # retraining while EWMA(probe loss) stays above band * the pre-trip
+    # healthy baseline — a retrain window that straddled the shift only
+    # half-recovers, and the rebase would otherwise normalize the
+    # plateau.  Must exceed 1; 0 disables (one retrain per trip).
+    autopilot_recovery_band: float = 1.35
+    # live probe sourcing (autopilot/probe_source.py): reservoir capacity,
+    # the label-delay model (ground truth arrives `label_delay` requests
+    # late), and the cadence at which the sampled rows rotate in as the
+    # canary probe set (each rotation re-probes the promoted version —
+    # the drift signal's sample rate)
+    autopilot_probe_capacity: int = 64
+    autopilot_label_delay: int = 0
+    autopilot_source_refresh_s: float = 2.0
+
     _CHOICES = {
         "model": ("hinge", "svm", "logistic", "least_squares"),
         "engine": ("mesh", "rpc"),
@@ -486,6 +530,48 @@ class Config:
                 "DSGD_SERVE_PROBE_REFRESH_S > 0 needs DSGD_SERVE_PROBE: "
                 "the refresh re-reads the probe file on its cadence "
                 "(docs/SERVING.md)")
+        # -- continual-learning autopilot (docs/CONTINUAL.md) ---------------
+        if self.autopilot_poll_s <= 0:
+            raise ValueError("DSGD_AUTOPILOT_POLL_S must be > 0")
+        if self.autopilot_cooldown_s < 0:
+            raise ValueError("DSGD_AUTOPILOT_COOLDOWN_S must be >= 0")
+        if self.autopilot_drift_ratio <= 1.0:
+            raise ValueError(
+                "DSGD_AUTOPILOT_DRIFT_RATIO must be > 1 (the drift rule "
+                "compares EWMA probe loss against ratio x baseline)")
+        if self.autopilot_drift_patience < 1:
+            raise ValueError("DSGD_AUTOPILOT_DRIFT_PATIENCE must be >= 1")
+        if self.autopilot_drift_warmup < 0:
+            raise ValueError("DSGD_AUTOPILOT_DRIFT_WARMUP must be >= 0")
+        if self.autopilot_drift_floor < 0:
+            raise ValueError("DSGD_AUTOPILOT_DRIFT_FLOOR must be >= 0")
+        if self.autopilot_window < 1:
+            raise ValueError("DSGD_AUTOPILOT_WINDOW must be >= 1 rows")
+        if self.autopilot_max_retrains < 0:
+            raise ValueError(
+                "DSGD_AUTOPILOT_MAX_RETRAINS must be >= 0 (0 = unbounded)")
+        if self.autopilot_canary_timeout_s <= 0:
+            raise ValueError("DSGD_AUTOPILOT_CANARY_TIMEOUT_S must be > 0")
+        if self.autopilot_recovery_band and self.autopilot_recovery_band <= 1:
+            raise ValueError(
+                "DSGD_AUTOPILOT_RECOVERY_BAND must be > 1 (0 disables "
+                "residual settling)")
+        if self.autopilot_probe_capacity < 1:
+            raise ValueError("DSGD_AUTOPILOT_PROBE_CAPACITY must be >= 1")
+        if self.autopilot_label_delay < 0:
+            raise ValueError("DSGD_AUTOPILOT_LABEL_DELAY must be >= 0")
+        if self.autopilot_source_refresh_s <= 0:
+            raise ValueError("DSGD_AUTOPILOT_SOURCE_REFRESH_S must be > 0")
+        if self.autopilot and self.role_override in ("serve", "worker"):
+            raise ValueError(
+                f"DSGD_AUTOPILOT has no {self.role_override} half: the "
+                f"flywheel lives in the dev/route/master roles "
+                f"(docs/CONTINUAL.md)")
+        if self.autopilot and self.serve_probe_refresh_s > 0:
+            raise ValueError(
+                "DSGD_AUTOPILOT and DSGD_SERVE_PROBE_REFRESH_S are "
+                "mutually exclusive: the traffic reservoir REPLACES the "
+                "operator-rotated probe file (docs/CONTINUAL.md)")
 
     @property
     def role(self) -> str:
@@ -589,6 +675,35 @@ class Config:
             serve_state=_env("DSGD_SERVE_STATE", None, str),
             serve_probe_refresh_s=_env("DSGD_SERVE_PROBE_REFRESH_S",
                                        cls.serve_probe_refresh_s, float),
+            autopilot=_env("DSGD_AUTOPILOT", cls.autopilot, bool),
+            autopilot_poll_s=_env("DSGD_AUTOPILOT_POLL_S",
+                                  cls.autopilot_poll_s, float),
+            autopilot_cooldown_s=_env("DSGD_AUTOPILOT_COOLDOWN_S",
+                                      cls.autopilot_cooldown_s, float),
+            autopilot_drift_ratio=_env("DSGD_AUTOPILOT_DRIFT_RATIO",
+                                       cls.autopilot_drift_ratio, float),
+            autopilot_drift_patience=_env("DSGD_AUTOPILOT_DRIFT_PATIENCE",
+                                          cls.autopilot_drift_patience, int),
+            autopilot_drift_warmup=_env("DSGD_AUTOPILOT_DRIFT_WARMUP",
+                                        cls.autopilot_drift_warmup, int),
+            autopilot_drift_floor=_env("DSGD_AUTOPILOT_DRIFT_FLOOR",
+                                       cls.autopilot_drift_floor, float),
+            autopilot_window=_env("DSGD_AUTOPILOT_WINDOW",
+                                  cls.autopilot_window, int),
+            autopilot_max_retrains=_env("DSGD_AUTOPILOT_MAX_RETRAINS",
+                                        cls.autopilot_max_retrains, int),
+            autopilot_recovery_band=_env("DSGD_AUTOPILOT_RECOVERY_BAND",
+                                         cls.autopilot_recovery_band, float),
+            autopilot_canary_timeout_s=_env(
+                "DSGD_AUTOPILOT_CANARY_TIMEOUT_S",
+                cls.autopilot_canary_timeout_s, float),
+            autopilot_probe_capacity=_env("DSGD_AUTOPILOT_PROBE_CAPACITY",
+                                          cls.autopilot_probe_capacity, int),
+            autopilot_label_delay=_env("DSGD_AUTOPILOT_LABEL_DELAY",
+                                       cls.autopilot_label_delay, int),
+            autopilot_source_refresh_s=_env(
+                "DSGD_AUTOPILOT_SOURCE_REFRESH_S",
+                cls.autopilot_source_refresh_s, float),
         )
         return dataclasses.replace(cfg, **overrides)
 

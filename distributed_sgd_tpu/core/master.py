@@ -1024,6 +1024,11 @@ class MasterNode:
 
         def _scrape_loop():
             while not self._hb_stop.wait(interval_s):
+                # leak-slope gauges first (docs/OBSERVABILITY.md): the
+                # sidecar is the process's hours-horizon cadence, so RSS /
+                # open-fd samples land in the same exposition the scrape
+                # refreshes — what the flywheel bench's slope assert reads
+                metrics_mod.sample_process_gauges(self.metrics)
                 self.telemetry.scrape(self._members(), self.rpc_policy,
                                       deadline_s=probe_timeout)
 

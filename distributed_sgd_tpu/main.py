@@ -84,14 +84,15 @@ def _make_checkpointer(cfg: Config):
 
 
 def _restore_weights(ckpt):
-    """Latest checkpointed weights (for async resume), or None."""
+    """Latest checkpointed weights (async resume / autopilot warm
+    start), or None."""
     if ckpt is None:
         return None
     restored = ckpt.restore_latest()
     if restored is None:
         return None
     step, state = restored
-    log.info("resuming async fit from checkpoint at step %d", step)
+    log.info("warm start from checkpoint at step %d", step)
     return np.asarray(state["weights"])
 
 
@@ -515,6 +516,112 @@ def _load_probe(cfg: Config):
     return probe
 
 
+def _autopilot_probe_source(cfg: Config):
+    """DSGD_AUTOPILOT on the route role -> (ProbeReservoir, refresh_s):
+    live probe sourcing (autopilot/probe_source.py) replaces the
+    operator-rotated probe file.  The env-driven role joins ground truth
+    through the seeded DriftingStream oracle — the documented assumption
+    (docs/CONTINUAL.md) that the traffic IS the synthetic stream, which
+    is exactly what the dev role and the flywheel bench send; a
+    production integrator supplies its own labeler (feedback-log join)
+    programmatically."""
+    if not cfg.autopilot:
+        return None, 0.0
+    from distributed_sgd_tpu.autopilot import DriftingStream, ProbeReservoir
+
+    stream = DriftingStream(seed=cfg.seed)
+    reservoir = ProbeReservoir(
+        stream.oracle_labeler(), capacity=cfg.autopilot_probe_capacity,
+        seed=cfg.seed, label_delay=cfg.autopilot_label_delay,
+        recency=2 * cfg.autopilot_probe_capacity,
+        min_fill=max(1, cfg.autopilot_probe_capacity // 2))
+    log.info(
+        "autopilot probe sourcing: reservoir capacity=%d label_delay=%d "
+        "refresh=%gs", reservoir.capacity, reservoir.label_delay,
+        cfg.autopilot_source_refresh_s)
+    return reservoir, cfg.autopilot_source_refresh_s
+
+
+def _autopilot_stream_build(cfg: Config):
+    """DSGD_AUTOPILOT on the master role -> the stream plane
+    (autopilot/stream.py): the resident corpus is the newest
+    DSGD_AUTOPILOT_WINDOW rows of the seeded drifting stream and the
+    eval set is pinned to the window's trailing edge, so the existing
+    early-stopping machinery judges convergence against the CURRENT
+    distribution.  A master relaunch warm-starts automatically from the
+    epoch-cadence checkpoint (fit_sync's restore path); grant it a
+    raised DSGD_MAX_EPOCHS budget and the relaunch IS one flywheel
+    retrain round (the dev role and benches/bench_flywheel.py run the
+    full closed loop hands-free in one process)."""
+    from distributed_sgd_tpu.autopilot import DriftingStream
+
+    stream = DriftingStream(seed=cfg.seed)
+    train = measure.duration_log(
+        "stream window materialized",
+        lambda: stream.rows(0, cfg.autopilot_window), log)
+    test = stream.eval_set(max(256, cfg.autopilot_window // 8),
+                           at=cfg.autopilot_window)
+    ds = dim_sparsity(train)
+    model = make_model(cfg.model, cfg.lam, train.n_features,
+                       dim_sparsity=ds)
+    return train, test, model
+
+
+def _run_dev_flywheel(cfg: Config) -> None:
+    """DSGD_ROLE=dev + DSGD_AUTOPILOT: the full closed loop in one
+    process (autopilot/flywheel.py).  A DevCluster trains on the stream
+    window, a ServingFleet serves the checkpoints, the router sources
+    its probe set from its own traffic, and the controller drives drift
+    -> retrain -> canary -> promote hands-free.  Pumps one complete
+    shift through the fleet (the stream's schedule decides when), waits
+    for the controller to settle, logs the summary, and exits."""
+    from distributed_sgd_tpu.autopilot import (
+        DriftDetector,
+        DriftingStream,
+        Flywheel,
+    )
+
+    stream = DriftingStream(seed=cfg.seed)
+    horizon = stream.shift_at + 2 * cfg.autopilot_window
+    detector = DriftDetector(
+        ratio=cfg.autopilot_drift_ratio,
+        patience=cfg.autopilot_drift_patience,
+        warmup=cfg.autopilot_drift_warmup,
+        abs_floor=cfg.autopilot_drift_floor)
+    fly = Flywheel(
+        stream, horizon_rows=horizon, window_rows=cfg.autopilot_window,
+        model=cfg.model, lam=cfg.lam, n_workers=2,
+        n_replicas=max(2, cfg.serve_replicas),
+        max_epochs=cfg.max_epochs, batch_size=cfg.batch_size,
+        learning_rate=cfg.learning_rate, patience=cfg.patience,
+        conv_delta=cfg.conv_delta,
+        probe_capacity=cfg.autopilot_probe_capacity,
+        label_delay=cfg.autopilot_label_delay,
+        source_refresh_s=cfg.autopilot_source_refresh_s,
+        canary_fraction=cfg.serve_canary or 0.5,
+        detector=detector, poll_s=cfg.autopilot_poll_s,
+        cooldown_s=cfg.autopilot_cooldown_s,
+        canary_timeout_s=cfg.autopilot_canary_timeout_s,
+        max_retrains=cfg.autopilot_max_retrains,
+        recovery_band=cfg.autopilot_recovery_band,
+        seed=cfg.seed, ckpt_dir=cfg.checkpoint_dir or None,
+        telemetry_port=cfg.telemetry_port if cfg.telemetry else None,
+    )
+    log.info("dev flywheel: horizon=%d rows (%s shift at %d), window=%d",
+             horizon, stream.schedule, stream.shift_at,
+             cfg.autopilot_window)
+    fly.start()
+    try:
+        summary = fly.run()
+    finally:
+        fly.stop()
+    log.info(
+        "flywheel done: served=%d dropped=%d retrains=%d promoted=%d "
+        "rolled_back=%d state=%s", summary["served"], summary["dropped"],
+        summary["retrains"], summary["promoted"], summary["rolled_back"],
+        summary["state"])
+
+
 def _serve_distributor(cfg: Config):
     """DSGD_SERVE_PUSH on a training role -> started CheckpointDistributor
     (None when unset): every checkpoint the fit writes streams to the
@@ -611,6 +718,10 @@ def _run_role(cfg: Config, role: str) -> None:
         from distributed_sgd_tpu.serving.push import parse_targets
         from distributed_sgd_tpu.serving.router import ServingRouter
 
+        # DSGD_AUTOPILOT: live probe sourcing — the router reservoir-
+        # samples its own Predict traffic into the canary probe set
+        # (autopilot/probe_source.py, docs/CONTINUAL.md)
+        probe_source, source_refresh_s = _autopilot_probe_source(cfg)
         router = ServingRouter(
             parse_targets(cfg.serve_targets), port=cfg.serve_port,
             model=cfg.model, lam=cfg.lam,
@@ -625,6 +736,8 @@ def _run_role(cfg: Config, role: str) -> None:
             # in from the probe file on a cadence (ROADMAP 3c)
             probe_path=cfg.serve_probe,
             probe_refresh_s=cfg.serve_probe_refresh_s,
+            probe_source=probe_source,
+            probe_source_refresh_s=source_refresh_s,
         ).start()
         log.info("routing on :%d over %s (canary=%g, hedge=%gms)",
                  router.bound_port, cfg.serve_targets, cfg.serve_canary,
@@ -683,6 +796,11 @@ def _run_role(cfg: Config, role: str) -> None:
             server.stop()
         return
     if role == "dev":
+        if cfg.autopilot:
+            # the full train/serve flywheel in one process — drift ->
+            # retrain -> canary -> promote hands-free (docs/CONTINUAL.md)
+            _run_dev_flywheel(cfg)
+            return
         train, test, model = build(cfg)
         _select_scatter(cfg, train)
         distributor = _serve_distributor(cfg)
@@ -698,7 +816,12 @@ def _run_role(cfg: Config, role: str) -> None:
         from distributed_sgd_tpu.core.master import MasterNode
 
         _install_chaos(cfg)
-        train, test, model = build(cfg)
+        if cfg.autopilot:
+            # stream plane: corpus = the newest stream window, eval
+            # pinned to its trailing edge (docs/CONTINUAL.md)
+            train, test, model = _autopilot_stream_build(cfg)
+        else:
+            train, test, model = build(cfg)
         _select_scatter(cfg, train)
         master = MasterNode(
             cfg.host, cfg.port, train, test, model,
@@ -710,6 +833,14 @@ def _run_role(cfg: Config, role: str) -> None:
             # the ONE cluster-level /metrics endpoint
             master.enable_telemetry(cfg.telemetry_port)
         criterion = no_improvement(patience=cfg.patience, min_delta=cfg.conv_delta)
+        if cfg.autopilot:
+            from distributed_sgd_tpu.autopilot import continual_criterion
+
+            # continual eval: convergence judged on the last few evals
+            # only — a warm-started retrain must not be stopped by a
+            # best earned on a distribution that no longer exists
+            criterion = continual_criterion(
+                criterion, horizon=2 * cfg.patience + 1)
         master.await_ready()
         ckpt = _make_checkpointer(cfg)
         distributor = _serve_distributor(cfg)

@@ -48,6 +48,8 @@ class ServingFleet:
         state_path: Optional[str] = None,
         probe_path: Optional[str] = None,
         probe_refresh_s: float = 0.0,
+        probe_source=None,
+        probe_source_refresh_s: float = 0.0,
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
@@ -69,7 +71,8 @@ class ServingFleet:
             request_timeout_s=request_timeout_s,
             telemetry_port=telemetry_port, metrics=metrics, seed=seed,
             state_path=state_path, probe_path=probe_path,
-            probe_refresh_s=probe_refresh_s,
+            probe_refresh_s=probe_refresh_s, probe_source=probe_source,
+            probe_source_refresh_s=probe_source_refresh_s,
         )
 
     @property

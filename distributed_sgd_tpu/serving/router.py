@@ -184,6 +184,8 @@ class ServingRouter:
         state_path: Optional[str] = None,
         probe_path: Optional[str] = None,
         probe_refresh_s: float = 0.0,
+        probe_source=None,
+        probe_source_refresh_s: float = 0.0,
     ):
         if not replicas:
             raise ValueError("a router needs at least one replica endpoint")
@@ -191,6 +193,15 @@ class ServingRouter:
             raise ValueError(
                 "probe_refresh_s needs probe_path: the refresh re-reads "
                 "the probe file on its cadence")
+        if probe_source_refresh_s > 0 and probe_source is None:
+            raise ValueError(
+                "probe_source_refresh_s needs probe_source: the cadence "
+                "rotates the traffic reservoir into the probe set")
+        if probe_source is not None and probe_refresh_s > 0:
+            raise ValueError(
+                "probe_source and probe_refresh_s are mutually exclusive: "
+                "the reservoir REPLACES the operator-rotated probe file — "
+                "two refresh feeds would fight over the canary baseline")
         if not 0.0 <= canary_fraction <= 1.0:
             raise ValueError("canary_fraction must be in [0, 1]")
         if metrics is None:
@@ -227,6 +238,18 @@ class ServingRouter:
                 self._probe_mtime = os.path.getmtime(probe_path)
             except OSError:
                 self._probe_mtime = None
+        # live probe sourcing (autopilot/probe_source.py, DSGD_AUTOPILOT):
+        # with a reservoir attached, every routed Predict feeds it, and
+        # the health loop rotates the sampled rows in through
+        # refresh_probe() on its own cadence — each rotation re-probes the
+        # PROMOTED version on traffic sampled just now, so the refresh
+        # loss series (probe_losses()) is the drift signal the autopilot
+        # controller watches.  None (default): the Predict path is
+        # untouched and no series accumulates.
+        self._probe_source = probe_source
+        self._probe_source_refresh_s = max(0.0, float(probe_source_refresh_s))
+        self._source_next_check = 0.0
+        self._probe_loss_hist: List[float] = []
         self._model_name, self._lam = model, float(lam)
         self._probe_model = None  # built lazily (losses_from_margins only)
         self._promoted_version: Optional[int] = None
@@ -298,6 +321,16 @@ class ServingRouter:
     # -- the data plane ------------------------------------------------------
 
     def Predict(self, request, context):  # noqa: N802 - gRPC method name
+        if self._probe_source is not None:
+            # feed the probe reservoir from live traffic.  Canary probe
+            # evaluations go straight to replica stubs (_probe_loss), not
+            # through this handler, so the probe set never samples itself.
+            try:
+                self._probe_source.observe(
+                    np.asarray(request.indices, np.int32),
+                    np.asarray(request.values, np.float32))
+            except Exception as e:  # noqa: BLE001 - sampling must not drop a request
+                log.warning("probe-source observe failed: %s", e)
         tried: List[_Replica] = []
         last: Optional[grpc.RpcError] = None
         with measure.span("route.predict", metrics=self.metrics, root=False):
@@ -423,6 +456,8 @@ class ServingRouter:
             self._health_pass()
             if self._probe_refresh_s > 0:
                 self._maybe_refresh_probe()
+            if self._probe_source is not None and self._probe_source_refresh_s > 0:
+                self._maybe_refresh_from_source()
 
     # -- canary probe-set refresh (ROADMAP 3c; docs/SERVING.md) --------------
 
@@ -454,6 +489,10 @@ class ServingRouter:
             self._checker.refresh(best_loss=loss)
             if loss is not None and np.isfinite(loss):
                 self.metrics.gauge(metrics_mod.ROUTER_CANARY_LOSS).set(loss)
+            if loss is not None:
+                # the refresh-loss series: promoted version vs the rows
+                # live traffic produced NOW — the autopilot drift signal
+                self._probe_loss_hist.append(float(loss))
             self.metrics.counter(
                 metrics_mod.ROUTER_PROBE_REFRESH).increment()
             self._persist_state()
@@ -485,6 +524,44 @@ class ServingRouter:
         except Exception as e:  # noqa: BLE001 - a bad file must not kill health
             log.warning("probe refresh from %s failed: %s",
                         self._probe_path, e)
+
+    def _maybe_refresh_from_source(self) -> None:
+        """Health-loop tick: once per source-refresh period, rotate the
+        traffic reservoir's current sample in as the probe set.  Unlike
+        the file feed there is no mtime to gate on — the reservoir
+        evolves with every request — so every period with a ready
+        (min-fill reached) reservoir refreshes, and each refresh
+        re-probes the promoted version on just-sampled traffic: the
+        probe-loss series the autopilot controller reads."""
+        now = time.monotonic()
+        if now < self._source_next_check:
+            return
+        self._source_next_check = now + self._probe_source_refresh_s
+        if not self._probe_source.ready():
+            return
+        rows = self._probe_source.rows()
+        try:
+            self.refresh_probe(rows)
+        except Exception as e:  # noqa: BLE001 - a bad refresh must not kill health
+            log.warning("probe refresh from traffic reservoir failed: %s", e)
+            return
+        self.metrics.counter(metrics_mod.ROUTER_PROBE_SOURCED).increment()
+        self.metrics.gauge(metrics_mod.ROUTER_PROBE_FILL).set(
+            self._probe_source.fill)
+
+    # -- the autopilot's read side (docs/CONTINUAL.md) -----------------------
+
+    def probe_losses(self) -> List[float]:
+        """The probe-refresh loss series, oldest first: the promoted
+        version's loss on each successive probe rotation.  Floats only,
+        appended once per refresh — bounded by process lifetime at the
+        refresh cadence, read by AutopilotController."""
+        with self._push_lock:
+            return list(self._probe_loss_hist)
+
+    @property
+    def promoted_version(self) -> Optional[int]:
+        return self._promoted_version
 
     # -- checkpoint distribution + canary (PushWeights) ----------------------
 
@@ -614,6 +691,15 @@ class ServingRouter:
             # seed the LossChecker baseline without weights: best_loss is
             # the only field the canary rule reads (leaky=1.0 checker)
             self._checker.best_loss = best
+        if self._probe_source is not None and state.get("probe_source"):
+            # restore the traffic reservoir: counters + rows + pending
+            # lane, so the counter-derived Algorithm-R draw resumes the
+            # exact sampling sequence the pre-restart router was on
+            try:
+                self._probe_source.load_state(state["probe_source"])
+            except (KeyError, ValueError, TypeError) as e:
+                log.warning("probe-source state in %s unreadable (%s); "
+                            "reservoir starts empty", self._state_path, e)
         log.info(
             "router state restored from %s: promoted version %s, "
             "baseline %s, %d rejected", self._state_path,
@@ -630,6 +716,10 @@ class ServingRouter:
             "best_loss": best if best != float("inf") else None,
             "rejected": sorted(self._rejected),
         }
+        if self._probe_source is not None:
+            # bounded by construction (capacity + label_delay rows), so
+            # the sidecar stays a small JSON file
+            state["probe_source"] = self._probe_source.state_dict()
         try:
             from distributed_sgd_tpu.utils.fsio import atomic_write_json
 

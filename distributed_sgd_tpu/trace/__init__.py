@@ -64,6 +64,7 @@ EVENT_BCAST_STALE = "bcast.stale"          # stale replica -> full fallback
 EVENT_EF_ROLLBACK = "ef.rollback"          # worker rolled back an EF drain
 EVENT_TOPOLOGY_RESELECT = "topology.reselect"  # gossip edge re-routed past a breaker
 EVENT_HEALTH_TRIPPED = "health.tripped"        # training-health watchdog trip
+EVENT_AUTOPILOT_TRANSITION = "autopilot.transition"  # flywheel state change
 EVENT_SCATTER_SELECTED = "kernel.scatter"      # which scatter formulation ran
 
 

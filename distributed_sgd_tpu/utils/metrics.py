@@ -22,11 +22,12 @@ from __future__ import annotations
 import bisect
 import http.server
 import math
+import os
 import random
 import threading
 import time
 import zlib
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class Counter:
@@ -498,6 +499,8 @@ ROUTER_CANARY_PROMOTED = "router.canary.promoted"    # counter: versions promote
 ROUTER_CANARY_ROLLBACK = "router.canary.rollback"    # counter: versions rolled back
 ROUTER_CANARY_LOSS = "router.canary.probe_loss"      # gauge: last probe-set loss
 ROUTER_PROBE_REFRESH = "router.canary.probe_refresh"  # counter: probe-set rotations
+ROUTER_PROBE_SOURCED = "router.canary.probe_sourced"  # counter: reservoir rotations
+ROUTER_PROBE_FILL = "router.canary.probe_fill"        # gauge: reservoir rows held
 
 
 def record_push(metrics: "Metrics", form: str, wire_bytes: int,
@@ -537,6 +540,50 @@ SYNC_RESPLITS = "master.sync.resplit"            # counter: mid-fit membership r
 # WorkerNode at build time — so bench runs and the cluster /metrics
 # endpoint attribute which formulation a fit actually ran
 SCATTER_FORMULATION = "kernel.scatter.formulation"  # gauge: formulation index
+
+
+# -- continual-learning autopilot (autopilot/; docs/CONTINUAL.md) -------------
+# Registered only while an AutopilotController runs (DSGD_AUTOPILOT):
+# knobs-off, none of these exist (tests/test_flywheel.py identity gate).
+AUTOPILOT_STATE = "autopilot.state"                # gauge: index into controller.STATES
+AUTOPILOT_TRANSITIONS = "autopilot.transitions"    # counter: state transitions
+AUTOPILOT_DRIFT_TRIPPED = "autopilot.drift.tripped"  # counter: drift detector trips
+AUTOPILOT_DRIFT_EWMA = "autopilot.drift.ewma"      # gauge: detector's smoothed probe loss
+AUTOPILOT_RETRAINS = "autopilot.retrains"          # counter: retrains launched
+AUTOPILOT_RETRAIN_ERRORS = "autopilot.retrain.errors"  # counter: retrains that raised
+AUTOPILOT_PROMOTED = "autopilot.promoted"          # counter: retrains promoted via canary
+AUTOPILOT_ROLLED_BACK = "autopilot.rolled_back"    # counter: retrains rolled back / timed out
+
+
+# -- process leak-slope gauges (telemetry sidecar; docs/OBSERVABILITY.md) -----
+# Sampled by the master's telemetry-scrape sidecar (and the flywheel bench)
+# so hours-horizon runs can assert a bounded growth slope.  Never-set
+# gauges are NaN and stay off the wire, so nothing is exported until the
+# first sample.
+PROC_RSS_BYTES = "process.rss_bytes"               # gauge: resident set size
+PROC_OPEN_FDS = "process.open_fds"                 # gauge: open file descriptors
+
+
+def sample_process_gauges(metrics: "Metrics") -> Tuple[float, float]:
+    """Set PROC_RSS_BYTES / PROC_OPEN_FDS from /proc/self (Linux; a
+    platform without procfs leaves the gauges unset and returns NaN) and
+    return (rss_bytes, open_fds) for callers that keep their own series
+    — the leak-slope assert in benches/bench_flywheel.py."""
+    rss = fds = float("nan")
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    rss = float(line.split()[1]) * 1024.0  # kB -> bytes
+                    break
+        fds = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        return rss, fds
+    if rss == rss:
+        metrics.gauge(PROC_RSS_BYTES).set(rss)
+    if fds == fds:
+        metrics.gauge(PROC_OPEN_FDS).set(fds)
+    return rss, fds
 
 
 _GLOBAL = Metrics()

@@ -1,0 +1,415 @@
+"""Continual-learning autopilot (autopilot/; docs/CONTINUAL.md): the
+drifting stream's random-access determinism and shift schedules, the
+window-split/continual-eval training hooks, the drift detector — fires
+on a planted step-shift, stays QUIET on seeded quorum-timing noise (the
+false-positive gate) — and the controller state machine, driven
+synchronously through its `_step` seam: promotion, rollback, canary
+timeout, retrain failure, the max_retrains budget, and the residual
+settling rule that earns a second retrain when the first one only
+half-recovers."""
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.autopilot.controller import (
+    AutopilotController,
+    DriftDetector,
+)
+from distributed_sgd_tpu.autopilot.stream import (
+    BLOCK,
+    DriftingStream,
+    continual_criterion,
+    window_split,
+)
+from distributed_sgd_tpu.utils import metrics as mm
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+
+def _stream(**kw):
+    kw.setdefault("n_features", 512)
+    kw.setdefault("nnz", 8)
+    kw.setdefault("seed", 3)
+    kw.setdefault("shift_at", 2 * BLOCK)
+    return DriftingStream(**kw)
+
+
+# -- the drifting stream ------------------------------------------------------
+
+
+def test_stream_rows_random_access_deterministic():
+    """Row r is a pure function of (seed, r): any chunking, any call
+    order, any fresh instance reads byte-identical rows."""
+    s = _stream()
+    whole = s.rows(0, 3 * BLOCK)
+    part = s.rows(100, 150)  # straddles a block boundary
+    np.testing.assert_array_equal(part.indices, whole.indices[100:250])
+    np.testing.assert_array_equal(part.values, whole.values[100:250])
+    np.testing.assert_array_equal(part.labels, whole.labels[100:250])
+    again = _stream().rows(100, 150)
+    assert again.values.tobytes() == part.values.tobytes()
+    assert _stream(seed=4).rows(100, 150).values.tobytes() != \
+        part.values.tobytes()
+    # take() is just rows() at the cursor
+    s2 = _stream()
+    t1, t2 = s2.take(100), s2.take(100)
+    np.testing.assert_array_equal(t1.labels, whole.labels[:100])
+    np.testing.assert_array_equal(t2.labels, whole.labels[100:200])
+    assert s2.cursor == 200
+
+
+def test_stream_shift_schedules():
+    step = _stream(schedule="step", shift_at=100)
+    assert step.phase(99) == 0.0 and step.phase(100) == 1.0
+    ramp = _stream(schedule="ramp", shift_at=100, ramp_rows=200)
+    assert ramp.phase(99) == 0.0
+    assert ramp.phase(200) == pytest.approx(0.5)
+    assert ramp.phase(1000) == 1.0
+    rec = _stream(schedule="recurring", period_rows=100)
+    assert rec.phase(50) == 0.0 and rec.phase(150) == 1.0
+    assert rec.phase(250) == 0.0  # seasonality: it comes back
+    with pytest.raises(ValueError, match="schedule"):
+        _stream(schedule="sudden")
+    with pytest.raises(ValueError, match="magnitude"):
+        _stream(shift_magnitude=1.5)
+
+
+def test_step_shift_moves_labels_not_features():
+    """The concept moves, the vocabulary does not: a shifted stream and a
+    magnitude-0 twin draw identical features everywhere and identical
+    labels BEFORE the shift; after it only the labels diverge — so probe
+    loss measures the concept gap, not a feature artifact."""
+    shifted = _stream(shift_magnitude=1.0)
+    frozen = _stream(shift_magnitude=0.0)
+    pre_s, pre_f = shifted.rows(0, BLOCK), frozen.rows(0, BLOCK)
+    assert pre_s.values.tobytes() == pre_f.values.tobytes()
+    np.testing.assert_array_equal(pre_s.labels, pre_f.labels)
+    post_s = shifted.rows(shifted.shift_at, BLOCK)
+    post_f = frozen.rows(shifted.shift_at, BLOCK)
+    assert post_s.values.tobytes() == post_f.values.tobytes()
+    assert post_s.indices.tobytes() == post_f.indices.tobytes()
+    flipped = np.mean(post_s.labels != post_f.labels)
+    assert flipped > 0.10, f"step shift flipped only {flipped:.0%} of labels"
+
+
+def test_eval_set_pinned_and_held_out():
+    s = _stream()
+    e1, e2 = s.eval_set(64, at=0), s.eval_set(64, at=0)
+    assert e1.values.tobytes() == e2.values.tobytes()
+    np.testing.assert_array_equal(e1.labels, e2.labels)
+    assert s.cursor == 0  # eval draws never advance stream-time
+    # a post-shift eval set is a different draw at a different concept
+    e3 = s.eval_set(64, at=s.shift_at + BLOCK)
+    assert e3.values.tobytes() != e1.values.tobytes()
+    # held out: the eval lane never reproduces training rows
+    train = s.rows(0, 64)
+    assert e1.values.tobytes() != train.values.tobytes()
+
+
+def test_window_split_trains_only_the_window():
+    split = window_split(20, 60)
+    parts = split(100, 4)
+    ids = np.sort(np.concatenate(parts))
+    np.testing.assert_array_equal(ids, np.arange(20, 60))
+    # window clipped to the resident corpus
+    clipped = window_split(20, 200)(100, 2)
+    assert int(np.concatenate(clipped).max()) == 99
+    with pytest.raises(ValueError, match="bad stream window"):
+        window_split(30, 30)
+    with pytest.raises(ValueError, match="past the resident corpus"):
+        window_split(80, 120)(70, 2)
+
+
+def test_continual_criterion_truncates_history():
+    seen = []
+
+    def inner(losses):
+        seen.append(list(losses))
+        return False
+
+    crit = continual_criterion(inner, horizon=3)
+    crit([5.0, 4.0, 3.0, 2.0, 1.0])  # newest-first
+    assert seen == [[5.0, 4.0, 3.0]]
+    with pytest.raises(ValueError, match="horizon"):
+        continual_criterion(inner, horizon=0)
+
+
+def test_oracle_labeler_follows_the_concept_clock():
+    """The t-th label request is answered with the separator in force at
+    stream-time start + t — truth as the world holds it when the delayed
+    join lands, including across the shift."""
+    s = _stream(shift_at=2 * BLOCK)
+    start = s.shift_at - 5
+    rows = s.rows(start, 10)
+    labeler = s.oracle_labeler(start=start)
+    got = [labeler(rows.indices[i], rows.values[i]) for i in range(10)]
+    for i, y in enumerate(got):
+        w = s.separator(start + i)
+        want = 1.0 if float(
+            np.dot(rows.values[i].astype(np.float64),
+                   w[rows.indices[i]])) > 0 else -1.0
+        assert y == want
+
+
+# -- the drift detector -------------------------------------------------------
+
+
+def test_detector_fires_on_planted_step_shift():
+    d = DriftDetector(ratio=1.5, patience=2, warmup=4, abs_floor=0.1)
+    assert not any(d.observe(0.5) for _ in range(8))
+    post = [d.observe(1.4) for _ in range(4)]
+    assert any(post), "a 2.8x loss step must trip the detector"
+    assert post.index(True) <= 2, "the trip must land within patience+1"
+
+
+def test_detector_quiet_under_quorum_timing_noise():
+    """The false-positive gate: seeded wiggle around a healthy loss —
+    reservoir churn, quorum-timing jitter — must NEVER trip."""
+    d = DriftDetector(ratio=1.5, patience=2, warmup=4, abs_floor=0.1)
+    rng = np.random.default_rng(11)
+    losses = 0.5 + 0.05 * rng.standard_normal(300)
+    assert not any(d.observe(float(x)) for x in losses)
+
+
+def test_detector_abs_floor_guards_tiny_baselines():
+    """Near-zero baselines quantize: a 5x RATIO at loss 0.05 is sampling
+    noise, not drift — the absolute floor keeps it quiet, while a real
+    jump past baseline + floor still trips."""
+    d = DriftDetector(ratio=1.5, patience=2, warmup=3, abs_floor=0.1,
+                      alpha=1.0)
+    for _ in range(3):
+        d.observe(0.01)
+    assert not any(d.observe(0.05) for _ in range(10))
+    assert [d.observe(0.2), d.observe(0.2)] == [False, True]
+
+
+def test_detector_nonfinite_trips_immediately():
+    d = DriftDetector()
+    assert d.observe(float("nan"))
+    assert d.observe(float("inf"))
+
+
+def test_detector_rebase_reanchors():
+    d = DriftDetector(ratio=1.5, patience=2, warmup=2, abs_floor=0.05,
+                      alpha=1.0)
+    for _ in range(4):
+        d.observe(0.2)
+    d.rebase()
+    # the old 0.2 baseline is gone: 0.8 is just the new normal
+    assert not any(d.observe(0.8) for _ in range(6))
+    assert d._baseline == pytest.approx(0.8)
+
+
+def test_detector_validation():
+    for bad in (dict(alpha=0.0), dict(ratio=1.0), dict(abs_floor=-0.1)):
+        with pytest.raises(ValueError):
+            DriftDetector(**bad)
+
+
+# -- the controller state machine (synchronous via _step) ---------------------
+
+
+class _FakeRouter:
+    """probe_losses + the two canary counters: all the controller reads."""
+
+    def __init__(self):
+        self.metrics = Metrics()
+        self.promoted_version = 7
+        self._losses = []
+
+    def feed(self, *losses):
+        self._losses.extend(losses)
+
+    def probe_losses(self):
+        return list(self._losses)
+
+
+def _controller(router, retrain, **kw):
+    kw.setdefault("detector", DriftDetector(
+        alpha=1.0, ratio=1.5, patience=2, warmup=2, abs_floor=0.05))
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("canary_timeout_s", 0.3)
+    kw.setdefault("metrics", Metrics())
+    return AutopilotController(router, retrain, **kw)
+
+
+def _promote(router):
+    router.metrics.counter(mm.ROUTER_CANARY_PROMOTED).increment()
+
+
+def test_controller_promotion_cycle():
+    r = _FakeRouter()
+    c = _controller(r, lambda: _promote(r))
+    r.feed(0.2, 0.2, 0.2)
+    c._step()
+    assert c.state == "SERVING" and c.retrains == 0
+    r.feed(1.0, 1.0)  # 5x the baseline for patience=2 observations
+    c._step()
+    assert c.retrains == 1
+    assert c.state == "SERVING"  # full cycle closed within the step
+    assert c.metrics.counter(mm.AUTOPILOT_DRIFT_TRIPPED).value == 1
+    assert c.metrics.counter(mm.AUTOPILOT_PROMOTED).value == 1
+    assert c.metrics.counter(mm.AUTOPILOT_ROLLED_BACK).value == 0
+    # SERVING -> DRIFT_DETECTED -> RETRAINING -> CANARY -> PROMOTED -> SERVING
+    assert c.metrics.counter(mm.AUTOPILOT_TRANSITIONS).value == 5
+    assert c.detector._checks == 0  # rebased: the new normal starts fresh
+
+
+def test_controller_rollback_cycle():
+    r = _FakeRouter()
+    c = _controller(
+        r, lambda: r.metrics.counter(mm.ROUTER_CANARY_ROLLBACK).increment())
+    r.feed(0.2, 0.2, 1.0, 1.0)
+    c._step()
+    assert c.retrains == 1 and c.state == "SERVING"
+    assert c.metrics.counter(mm.AUTOPILOT_ROLLED_BACK).value == 1
+    assert c.metrics.counter(mm.AUTOPILOT_PROMOTED).value == 0
+
+
+def test_controller_canary_timeout_counts_as_rollback():
+    r = _FakeRouter()
+    c = _controller(r, lambda: None, canary_timeout_s=0.1)
+    r.feed(0.2, 0.2, 1.0, 1.0)
+    c._step()
+    assert c.metrics.counter(mm.AUTOPILOT_ROLLED_BACK).value == 1
+    assert c.state == "SERVING"
+
+
+def test_controller_survives_retrain_failure():
+    r = _FakeRouter()
+
+    def boom():
+        raise RuntimeError("fit fell over")
+
+    c = _controller(r, boom)
+    r.feed(0.2, 0.2, 1.0, 1.0)
+    c._step()
+    assert c.state == "SERVING"
+    assert c.retrains == 0
+    assert c.metrics.counter(mm.AUTOPILOT_RETRAIN_ERRORS).value == 1
+
+
+def test_controller_max_retrains_budget():
+    r = _FakeRouter()
+    c = _controller(r, lambda: _promote(r), max_retrains=1)
+    r.feed(0.2, 0.2, 1.0, 1.0)
+    c._step()
+    assert c.retrains == 1
+    r.feed(0.2, 0.2, 1.0, 1.0)  # a second shift after the rebase
+    c._step()
+    assert c.retrains == 1, "the budget must cap the flywheel"
+    assert c.metrics.counter(mm.AUTOPILOT_DRIFT_TRIPPED).value == 1
+
+
+def test_controller_residual_settling_earns_a_second_retrain():
+    """A retrain window straddling the shift only half-recovers; the
+    post-promotion rebase would normalize that plateau.  The settling
+    rule holds the pre-trip baseline across the cycle and keeps
+    retraining until the EWMA is back inside recovery_band of it."""
+    r = _FakeRouter()
+    c = _controller(r, lambda: _promote(r), recovery_band=1.3)
+    r.feed(0.2, 0.2, 0.2)
+    c._step()
+    r.feed(1.0, 1.0)
+    c._step()  # trip -> retrain 1 -> promote -> rebase
+    assert c.retrains == 1
+    assert c._settle_baseline == pytest.approx(0.2)
+    # the plateau IS the detector's fresh baseline (no ratio trip), but
+    # it sits above 1.3 * 0.2 -> the residual rule fires
+    r.feed(0.5, 0.5, 0.5)
+    c._step()
+    assert c.retrains == 2
+    assert c.metrics.counter(mm.AUTOPILOT_DRIFT_TRIPPED).value == 2
+    # after the second retrain the series settles inside the band: the
+    # cycle closes, the baseline releases, no third retrain
+    r.feed(0.21, 0.21, 0.21)
+    c._step()
+    assert c.retrains == 2
+    assert c._settle_baseline is None
+
+
+def test_controller_residual_disabled_at_band_zero():
+    r = _FakeRouter()
+    c = _controller(r, lambda: _promote(r), recovery_band=0.0)
+    r.feed(0.2, 0.2, 0.2, 1.0, 1.0)
+    c._step()
+    assert c.retrains == 1 and c._settle_baseline is None
+    r.feed(0.5, 0.5, 0.5)  # the half-recovered plateau: tolerated
+    c._step()
+    assert c.retrains == 1
+
+
+def test_controller_thread_lifecycle():
+    r = _FakeRouter()
+    c = _controller(r, lambda: _promote(r), poll_s=0.02)
+    import threading
+    import time
+
+    c.start()
+    try:
+        assert any("autopilot" in t.name for t in threading.enumerate())
+        r.feed(0.2, 0.2, 1.0, 1.0)
+        deadline = time.time() + 5.0
+        while time.time() < deadline and c.retrains < 1:
+            time.sleep(0.02)
+        assert c.retrains == 1
+    finally:
+        c.stop()
+    assert not any("autopilot" in t.name for t in threading.enumerate())
+
+
+def test_controller_validation():
+    r = _FakeRouter()
+    with pytest.raises(ValueError, match="poll_s"):
+        _controller(r, lambda: None, poll_s=0.0)
+    with pytest.raises(ValueError, match="recovery_band"):
+        _controller(r, lambda: None, recovery_band=1.0)
+
+
+# -- the config knobs ---------------------------------------------------------
+
+
+def test_autopilot_config_knobs_validate():
+    from distributed_sgd_tpu.config import Config
+
+    assert Config().autopilot is False
+    for bad in (dict(autopilot_poll_s=0.0),
+                dict(autopilot_cooldown_s=-1.0),
+                dict(autopilot_drift_ratio=1.0),
+                dict(autopilot_drift_patience=0),
+                dict(autopilot_drift_warmup=-1),
+                dict(autopilot_drift_floor=-0.1),
+                dict(autopilot_window=0),
+                dict(autopilot_max_retrains=-1),
+                dict(autopilot_canary_timeout_s=0.0),
+                dict(autopilot_recovery_band=1.0),
+                dict(autopilot_probe_capacity=0),
+                dict(autopilot_label_delay=-1),
+                dict(autopilot_source_refresh_s=0.0)):
+        with pytest.raises(ValueError):
+            Config(**bad)
+    assert Config(autopilot_recovery_band=0.0).autopilot_recovery_band == 0.0
+    # the flywheel lives in the dev/route/master roles only
+    with pytest.raises(ValueError, match="no worker half"):
+        Config(autopilot=True, role_override="worker")
+    with pytest.raises(ValueError, match="no serve half"):
+        Config(autopilot=True, role_override="serve", checkpoint_dir="/tmp")
+    # the traffic reservoir REPLACES the operator-rotated probe file
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        Config(autopilot=True, serve_probe_refresh_s=1.0)
+
+
+def test_autopilot_env_knobs_parse(monkeypatch):
+    from distributed_sgd_tpu.config import Config
+
+    monkeypatch.setenv("DSGD_AUTOPILOT", "1")
+    monkeypatch.setenv("DSGD_AUTOPILOT_DRIFT_RATIO", "2.5")
+    monkeypatch.setenv("DSGD_AUTOPILOT_RECOVERY_BAND", "1.5")
+    monkeypatch.setenv("DSGD_AUTOPILOT_PROBE_CAPACITY", "48")
+    monkeypatch.setenv("DSGD_AUTOPILOT_LABEL_DELAY", "4")
+    cfg = Config.from_env()
+    assert cfg.autopilot is True
+    assert cfg.autopilot_drift_ratio == 2.5
+    assert cfg.autopilot_recovery_band == 1.5
+    assert cfg.autopilot_probe_capacity == 48
+    assert cfg.autopilot_label_delay == 4

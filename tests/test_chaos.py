@@ -221,6 +221,75 @@ def test_no_plan_returns_raw_channel(ping_server):
     assert isinstance(ch, grpc.Channel), "no plan must mean no wrapper"
 
 
+# -- the named scenario library and blast-radius scoping ----------------------
+
+
+def test_scenario_library_resolves_by_name():
+    """DSGD_CHAOS=scenario:NAME means the SAME seeded faults in a bench,
+    a bug report, and a CI job: every library entry parses, pins its own
+    seed, and resolve passes non-scenario specs through untouched."""
+    for name, spec in chaos.SCENARIOS.items():
+        plan = parse_plan(chaos.resolve_scenario(f"scenario:{name}"))
+        assert plan.seed != 0, f"{name} must pin its randomness"
+        assert parse_plan(spec) == plan
+    p = parse_plan(chaos.resolve_scenario("scenario:flaky-rack"))
+    assert p.drop == 0.03 and p.dup == 0.02 and not p.partitions
+    p = parse_plan(chaos.resolve_scenario("scenario:asym-partition"))
+    assert len(p.partitions) == 2
+    assert {q.name for q in p.partitions} == {"w1", "w2"}
+    p = parse_plan(chaos.resolve_scenario("scenario:thundering-rejoin"))
+    assert len(p.partitions) == 3  # the correlated blip
+    assert len({(q.at_s, q.dur_s) for q in p.partitions}) == 1
+    with pytest.raises(ValueError, match="unknown chaos scenario"):
+        chaos.resolve_scenario("scenario:meteor-strike")
+    assert chaos.resolve_scenario("seed=1;drop=0.5") == "seed=1;drop=0.5"
+
+
+def test_scenario_accepts_trailing_overrides():
+    """`scenario:NAME;key=val` keeps the library's seeded weather and
+    lets the caller adjust only its blast radius / extras."""
+    p = parse_plan(chaos.resolve_scenario("scenario:flaky-rack;scope=named"))
+    base = parse_plan(chaos.resolve_scenario("scenario:flaky-rack"))
+    assert p.scope == "named" and base.scope == "all"
+    assert (p.seed, p.drop, p.delay, p.dup) == (
+        base.seed, base.drop, base.delay, base.dup)
+    p = parse_plan(chaos.resolve_scenario(
+        "scenario:slow-disk;scope=named;grace=5s"))
+    assert p.scope == "named" and p.grace_s == 5.0
+    with pytest.raises(ValueError, match="scope"):
+        parse_plan("drop=0.1;scope=everything")
+
+
+def test_scope_named_confines_blast_radius(ping_server):
+    """scope=named: faults land only on edges touching a NAMED endpoint
+    (the plane that registered via name_endpoint); un-named planes — a
+    serving fleet, a bench load generator — run clear even under
+    drop=1.0."""
+    chaos.install("seed=1;drop=1.0;scope=named")
+    stub = WorkerStub(new_channel("127.0.0.1", ping_server))
+    # the endpoint is un-named: clear weather despite the certain drop
+    assert stub.Ping(pb.Empty(), timeout=5.0) is not None
+    # naming it brings it inside the storm
+    chaos.name_endpoint("127.0.0.1", ping_server, "w0")
+    stub2 = WorkerStub(new_channel("127.0.0.1", ping_server))
+    with pytest.raises(grpc.RpcError) as err:
+        stub2.Ping(pb.Empty(), timeout=0.2)
+    assert err.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+
+
+def test_in_scope_decision_table():
+    st = ChaosState(FaultPlan(seed=1, scope="named"))
+    st.name_endpoint("10.0.0.1", 80, "master")
+    assert st.in_scope(("10.0.0.1", 80), ("10.9.9.9", 1))  # origin named
+    assert st.in_scope(None, ("10.0.0.1", 80))             # target named
+    assert not st.in_scope(("10.9.9.9", 1), ("10.9.9.8", 2))
+    assert not st.in_scope(None, None)
+    # scope=all: everything is weather
+    assert ChaosState(FaultPlan(seed=1)).in_scope(None, None)
+    with pytest.raises(ValueError, match="scope"):
+        FaultPlan(seed=1, scope="some")
+
+
 # -- end-to-end: chaos + quorum soak ------------------------------------------
 
 

@@ -1,0 +1,174 @@
+"""The continual-learning flywheel (autopilot/flywheel.py) and the
+knobs-off identity guarantee (docs/CONTINUAL.md).
+
+Knobs-off first: with DSGD_AUTOPILOT unset nothing from this subsystem
+runs — no autopilot thread, no reservoir on the router, no new
+instruments in the registry — and both the training weights and the
+serving wire are byte-identical run to run (the autopilot code being in
+the tree perturbs nothing).
+
+Then the flywheel itself, end to end at a tiny dense shape: a planted
+step shift in live traffic trips the detector, a warm-start retrain
+flows through the distributor's canary gate, at least one version
+promotes, and not one Predict is dropped — zero operator actions.  The
+full weathered run with recovery/leak asserts is `bench.py --flywheel`
+(the slow-marked test below); this one keeps the loop inside tier-1."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.utils import metrics as mm
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+pytestmark = pytest.mark.filterwarnings(
+    "ignore::DeprecationWarning", "ignore::FutureWarning")
+
+
+def _no_autopilot_threads():
+    return not any("autopilot" in t.name for t in threading.enumerate())
+
+
+def _fit_weights(tmpdir=None):
+    """A small knobs-off fit, fresh cluster each call."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+    from distributed_sgd_tpu.data.synthetic import rcv1_like
+    from distributed_sgd_tpu.models.linear import make_model
+
+    data = rcv1_like(384, n_features=256, nnz=8, seed=11, idf_values=True)
+    train, test = train_test_split(data)
+    model = make_model("hinge", 1e-5, train.n_features,
+                       dim_sparsity=dim_sparsity(train))
+    ck = Checkpointer(tmpdir) if tmpdir else None
+    with DevCluster(model, train, test, n_workers=2, seed=0) as c:
+        res = c.master.fit_sync(
+            max_epochs=2, batch_size=16, learning_rate=0.5,
+            grad_timeout_s=30.0,
+            **({"checkpointer": ck, "checkpoint_every": 1} if ck else {}))
+    if ck:
+        ck.close()
+    return np.asarray(res.state.weights)
+
+
+def test_knobs_off_training_weights_byte_identical():
+    """Two fresh knobs-off fits at the same seeds produce bit-identical
+    weights: nothing the autopilot subsystem added leaks into the
+    default training path."""
+    w1, w2 = _fit_weights(), _fit_weights()
+    assert w1.tobytes() == w2.tobytes()
+    assert _no_autopilot_threads()
+
+
+def test_knobs_off_serving_wire_and_registry_untouched(tmp_path):
+    """A knobs-off fleet: no reservoir, no autopilot instruments, no
+    probe-loss series — and the Predict wire bytes replay identically
+    across two independent fleets serving the same checkpoint."""
+    import time
+
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+    from distributed_sgd_tpu.rpc.service import ServeStub, new_channel
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=64).astype(np.float32)
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(1, w)
+    ck.close()
+    rows = [(rng.choice(64, size=4, replace=False).astype(np.int32),
+             rng.normal(size=4).astype(np.float32)) for _ in range(16)]
+
+    def serve_bytes():
+        m = Metrics()
+        with ServingFleet(str(tmp_path / "ckpt"), n_replicas=2,
+                          ckpt_poll_s=30.0, health_s=0.2, metrics=m) as f:
+            channel = new_channel("127.0.0.1", f.router_port)
+            stub = ServeStub(channel)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    if stub.ServeHealth(pb.Empty(), timeout=2).ok:
+                        break
+                except Exception:  # noqa: BLE001 - replicas still loading
+                    pass
+                time.sleep(0.05)
+            replies = [stub.Predict(
+                pb.PredictRequest(indices=i, values=v),
+                timeout=5).SerializeToString() for i, v in rows]
+            channel.close()
+            assert f.router._probe_source is None
+            assert f.router.probe_losses() == []
+        names = ([c.name for c in m.counters()]
+                 + [g.name for g in m.gauges()])
+        return replies, names
+
+    replies1, names1 = serve_bytes()
+    replies2, _ = serve_bytes()
+    assert replies1 == replies2, "knobs-off Predict wire must replay"
+    assert not any(n.startswith("autopilot.") for n in names1)
+    assert mm.ROUTER_PROBE_SOURCED not in names1
+    assert mm.ROUTER_PROBE_FILL not in names1
+    assert _no_autopilot_threads()
+
+
+def test_flywheel_shift_retrain_promote_end_to_end(tmp_path):
+    """The tier-1 flywheel smoke: serve-offset traffic (train on the
+    past, serve the future), a step shift mid-horizon, hands-free
+    detect -> warm-start retrain -> canary -> promote, zero dropped
+    Predicts."""
+    from distributed_sgd_tpu.autopilot.controller import DriftDetector
+    from distributed_sgd_tpu.autopilot.flywheel import Flywheel
+    from distributed_sgd_tpu.autopilot.stream import DriftingStream
+
+    stream = DriftingStream(n_features=256, nnz=16, noise=0.05, seed=7,
+                            schedule="step", shift_at=512,
+                            shift_magnitude=1.0)
+    detector = DriftDetector(ratio=2.0, patience=2, warmup=4,
+                             abs_floor=0.25)
+    m = Metrics()
+    fly = Flywheel(
+        stream, horizon_rows=1536, window_rows=256,
+        n_workers=2, n_replicas=2, max_epochs=3, batch_size=16,
+        learning_rate=0.5, probe_capacity=24, label_delay=2,
+        source_refresh_s=0.2, canary_fraction=0.5, health_s=0.1,
+        detector=detector, poll_s=0.1, cooldown_s=0.3,
+        canary_timeout_s=30.0, max_retrains=2, seed=7,
+        ckpt_dir=str(tmp_path / "ckpt"), metrics=m)
+    fly.start()
+    try:
+        # the pace floor ties row progress to wall-clock: the 256
+        # pre-shift serving rows must span the detector's 4 warmup
+        # refreshes (0.2s cadence) even when an earlier test already
+        # warmed the predict jit cache — unpaced, a warm pump outruns
+        # the cadence and the baseline anchors on post-shift loss
+        summary = fly.run(chunk=64, pace_s=0.01, settle_timeout_s=120.0)
+    finally:
+        fly.stop()
+
+    assert summary["dropped"] == 0, "the zero-drop SLO broke"
+    assert summary["served"] == 1536 - 256  # the whole served horizon
+    assert summary["retrains"] >= 1, "the shift never triggered a retrain"
+    assert summary["promoted"] >= 1, "no retrained version promoted"
+    assert summary["state"] == "SERVING"
+    assert m.counter(mm.AUTOPILOT_DRIFT_TRIPPED).value >= 1
+    assert len(summary["probe_losses"]) > 0
+    # the flywheel's threads are down after stop()
+    assert _no_autopilot_threads()
+
+
+@pytest.mark.slow
+def test_flywheel_smoke_bench_end_to_end():
+    """`bench.py --flywheel --smoke` is the CI flywheel gate: recovery
+    inside the parity band within the round budget, zero drops, >= 1
+    retrain and promotion, bounded leak slope — under scoped flaky-rack
+    weather on the training plane, through benches/regress.py."""
+    from benches.bench_flywheel import run_bench
+
+    r = run_bench(smoke=True)  # raises on any gate failure
+    assert r["dropped_info"] == 0
+    assert r["retrains_info"] >= 1
+    assert r["promoted_info"] >= 1
+    assert r["shift_recovery_rounds"] <= r["round_budget_info"]

@@ -1,0 +1,236 @@
+"""Live canary-probe sourcing (autopilot/probe_source.py): the reservoir
+is BOUNDED, seeded-deterministic (a pure function of seed + arrival
+order), models label delay without ever guessing a label, tracks a
+drifting stream through its recency horizon, and resumes its exact
+sampling sequence after a restart — both at the class level
+(state_dict/load_state) and through the router's DSGD_SERVE_STATE
+sidecar."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.autopilot.probe_source import ProbeReservoir
+from distributed_sgd_tpu.utils import metrics as mm
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+
+def _row(t, nnz=4, dim=64):
+    """Deterministic row #t; index 0 carries t so tests can read back
+    WHICH rows the reservoir kept."""
+    rng = np.random.default_rng((5, t))
+    idx = np.concatenate([[t], rng.choice(
+        np.arange(1, dim), size=nnz - 1, replace=False)]).astype(np.int32)
+    return idx, rng.normal(size=nnz).astype(np.float32)
+
+
+def _feed(res, ts):
+    for t in ts:
+        res.observe(*_row(t))
+
+
+def _kept(res):
+    return sorted(int(r[0][0]) for r in res.rows())
+
+
+def test_reservoir_is_bounded():
+    res = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=1, label_delay=3)
+    _feed(res, range(500))
+    assert res.fill == 8
+    assert res.seen == 500
+    state = res.state_dict()
+    assert len(state["rows"]) == 8
+    # the pending lane drains on every observe: never grows past the delay
+    assert len(state["pending"]) <= 3
+
+
+def test_reservoir_seeded_deterministic():
+    a = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=1)
+    b = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=1)
+    _feed(a, range(300))
+    _feed(b, range(300))
+    assert _kept(a) == _kept(b)
+    c = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=2)
+    _feed(c, range(300))
+    assert _kept(c) != _kept(a), "a different seed must sample differently"
+
+
+def test_label_delay_holds_rows_until_truth_arrives():
+    asked = []
+
+    def labeler(idx, val):
+        asked.append(int(idx[0]))
+        return 1.0
+
+    res = ProbeReservoir(labeler, capacity=16, seed=1, label_delay=5)
+    _feed(res, range(5))
+    assert asked == [] and res.fill == 0  # nothing has aged past the join
+    _feed(res, range(5, 12))
+    # rows age in arrival order, exactly label_delay requests late
+    assert asked == list(range(7))
+    assert res.fill == 7
+
+
+def test_truthless_rows_are_dropped_never_guessed():
+    res = ProbeReservoir(lambda i, v: None if int(i[0]) % 2 else 1.0,
+                         capacity=32, seed=1)
+    _feed(res, range(20))
+    kept = _kept(res)
+    assert kept == [t for t in range(20) if t % 2 == 0]
+
+
+def test_recency_horizon_tracks_a_drifting_stream():
+    """Uniform-over-history dilutes a shift forever; the biased variant
+    decays old rows geometrically, so after a long run the sample leans
+    recent."""
+    uniform = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=3)
+    recent = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=3, recency=16)
+    _feed(uniform, range(600))
+    _feed(recent, range(600))
+    assert np.mean(_kept(recent)) > np.mean(_kept(uniform))
+    assert min(_kept(recent)) > 400, "recency-bounded sample kept a fossil"
+
+
+def test_ready_uses_min_fill():
+    res = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=1, min_fill=4)
+    _feed(res, range(3))
+    assert not res.ready()
+    _feed(res, range(3, 6))
+    assert res.ready()
+
+
+def test_reservoir_validation():
+    for bad in (dict(capacity=0), dict(label_delay=-1),
+                dict(capacity=8, recency=4), dict(capacity=8, min_fill=9),
+                dict(capacity=8, min_fill=0)):
+        with pytest.raises(ValueError):
+            ProbeReservoir(lambda i, v: 1.0, **bad)
+
+
+def test_restart_resumes_the_exact_sampling_sequence():
+    """The acceptance item: state_dict -> load_state restores counters +
+    rows + pending lane, and because every replace decision is a pure
+    function of (seed, t), the restored reservoir and an uninterrupted
+    twin sample IDENTICALLY from then on."""
+    twin = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=7,
+                          label_delay=3, recency=16)
+    _feed(twin, range(100))
+    snap = json.loads(json.dumps(twin.state_dict()))  # JSON round-trip
+
+    restored = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=7,
+                              label_delay=3, recency=16)
+    restored.load_state(snap)
+    assert restored.fill == twin.fill and restored.seen == twin.seen
+    assert _kept(restored) == _kept(twin)
+    _feed(twin, range(100, 300))
+    _feed(restored, range(100, 300))
+    assert _kept(restored) == _kept(twin)
+    assert restored.state_dict() == json.loads(
+        json.dumps(twin.state_dict()))
+
+
+def test_observe_is_thread_safe():
+    res = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=1, label_delay=2)
+
+    def client(k):
+        for t in range(k * 100, k * 100 + 100):
+            res.observe(*_row(t))
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert res.seen == 400
+    assert res.fill == 8
+
+
+# -- through the router: traffic in, sidecar out ------------------------------
+
+
+def test_router_sources_probe_rows_and_persists_reservoir(tmp_path):
+    """End to end through a real fleet: live Predict traffic fills the
+    reservoir, the refresh cadence rotates it into the canary probe set
+    (counters + a probe-loss sample), and the DSGD_SERVE_STATE sidecar
+    carries the reservoir across a router restart."""
+    import time
+
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+    from distributed_sgd_tpu.rpc.service import ServeStub, new_channel
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+
+    rng = np.random.default_rng(9)
+    w = rng.normal(size=64).astype(np.float32)
+    w[w == 0] = 0.1
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(1, w)
+    ck.close()
+    state = str(tmp_path / "serve-state.json")
+
+    res1 = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=4,
+                          label_delay=2)
+    m1 = Metrics()
+    with ServingFleet(str(tmp_path / "ckpt"), n_replicas=2,
+                      ckpt_poll_s=30.0, health_s=0.1, canary_fraction=0.5,
+                      probe_source=res1, probe_source_refresh_s=0.1,
+                      metrics=m1, seed=4, state_path=state) as f:
+        channel = new_channel("127.0.0.1", f.router_port)
+        stub = ServeStub(channel)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                if stub.ServeHealth(pb.Empty(), timeout=2).ok:
+                    break
+            except Exception:  # noqa: BLE001 - replicas still loading
+                pass
+            time.sleep(0.05)
+        # promote a version through the canary gate: each later refresh
+        # re-probes IT against the freshly sampled rows (the drift signal)
+        from distributed_sgd_tpu.serving.push import WeightPusher
+
+        pusher = WeightPusher([("127.0.0.1", f.router_port)],
+                              metrics=Metrics())
+        assert pusher.push(2, w) == 1
+        pusher.close()
+        for t in range(40):
+            idx, val = _row(t)
+            stub.Predict(pb.PredictRequest(indices=idx, values=val),
+                         timeout=5)
+        # the refresh cadence rotates the sampled rows into the probe set
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and m1.counter(mm.ROUTER_PROBE_SOURCED).value == 0):
+            time.sleep(0.05)
+        assert m1.counter(mm.ROUTER_PROBE_SOURCED).value >= 1
+        assert m1.gauge(mm.ROUTER_PROBE_FILL).value == 8
+        assert len(f.router.probe_losses()) >= 1  # the drift signal
+        # the sidecar rewrites on each refresh: wait for one that has
+        # caught up with the full traffic count
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            persisted = json.load(open(state))
+            if persisted.get("probe_source", {}).get("seen") == 40:
+                break
+            time.sleep(0.05)
+        channel.close()
+
+    persisted = json.load(open(state))
+    assert persisted["probe_source"]["seen"] == 40
+    assert len(persisted["probe_source"]["rows"]) == 8
+
+    # restart: a fresh reservoir restores from the sidecar and holds the
+    # SAME sample + counters — the sampling sequence resumes exactly
+    res2 = ProbeReservoir(lambda i, v: 1.0, capacity=8, seed=4,
+                          label_delay=2)
+    with ServingFleet(str(tmp_path / "ckpt"), n_replicas=2,
+                      ckpt_poll_s=30.0, health_s=0.5, canary_fraction=0.5,
+                      probe_source=res2, probe_source_refresh_s=30.0,
+                      metrics=Metrics(), seed=4, state_path=state):
+        assert res2.seen == res1.seen
+        assert _kept(res2) == _kept(res1)
+    _feed(res1, range(40, 120))
+    _feed(res2, range(40, 120))
+    assert _kept(res2) == _kept(res1)

@@ -270,6 +270,29 @@ def test_rounds_per_s_is_a_throughput_class_not_a_timing():
     assert ok == []
 
 
+def test_recovery_rounds_gate_down_with_own_band():
+    """Flywheel recovery (`*_recovery_rounds`, benches/bench_flywheel.py)
+    counts probe-refresh rounds from shift to parity: lower is better,
+    gated at 50% — a detection/retrain slowdown that doubles the count
+    fails, canary-timing jitter under chaos weather does not."""
+    assert regress.direction("shift_recovery_rounds") == "down"
+    assert regress.tolerance_for("shift_recovery_rounds") == 0.50
+    hist = [{"metric": "flywheel_smoke", "shift_recovery_rounds": 20}] * 3
+    regs, lines = regress.check(
+        {"metric": "flywheel_smoke", "shift_recovery_rounds": 35}, hist,
+        tolerance=0.35)
+    assert regs == ["shift_recovery_rounds"]  # +75%: a real slowdown
+    assert any("tol 50%" in ln for ln in lines)
+    ok, _ = regress.check(
+        {"metric": "flywheel_smoke", "shift_recovery_rounds": 28}, hist,
+        tolerance=0.35)
+    assert ok == []  # +40%: chaos-stall jitter stays inside the band
+    ok, _ = regress.check(
+        {"metric": "flywheel_smoke", "shift_recovery_rounds": 6}, hist,
+        tolerance=0.35)
+    assert ok == []  # faster recovery can never regress
+
+
 def test_scale_eff_is_a_higher_is_better_class():
     """Scaling efficiency (`*_scale_eff`, benches/bench_scale.py) gates UP
     with its own class band: a flattening collapse (the master going
