@@ -31,10 +31,14 @@ Eviction-budget sizing (the knob table in docs/SCALING.md): the longest
 partition black-holes one worker's heartbeat probes for its whole window,
 so `heartbeat_s * heartbeat_max_misses` MUST exceed the longest partition
 (+ one probe period of slack) or the soak's own weather evicts a live
-worker.  Quorum is N-2 with hedging OFF: a hedge ships a straggler's
-sample ids to a donor whose host-local resident slice does not cover them
-— the donor would slide its resident window to serve it, thrashing the
-O(delta) accounting (docs/HIERARCHY.md's membership-stability caveat).
+worker.  Quorum is N-2 with hedging ON: a hedge ships a straggler's
+sample ids to a donor whose host-local resident slice does not cover
+them, and the donor serves it from a bounded TRANSIENT scratch read
+through its RowReader (core/worker.py compute_gradient_hedged) — its
+resident window never slides for someone else's rows, so the O(delta)
+reload accounting this soak gates stays clean (the old hedge=False ban
+existed because hedges used to route through ensure_rows; see
+docs/HIERARCHY.md and docs/AGGREGATION.md).
 
 Run: ``python bench.py --soak [--smoke]``.  One JSON line on stdout;
 diagnostics to stderr; rows append to benches/history.json under the
@@ -213,7 +217,7 @@ def _run_soak(train, test, make, cfg: dict) -> dict:
                 max_epochs=cfg["epochs"], batch_size=cfg["batch"],
                 learning_rate=cfg["lr"],
                 grad_timeout_s=cfg["grad_timeout_s"], grad_retries=6,
-                quorum=quorum, straggler_soft_s=cfg["soft_s"], hedge=False,
+                quorum=quorum, straggler_soft_s=cfg["soft_s"], hedge=True,
                 stream=True, fanin_lanes=LANES, stage_pool=POOL,
             )
         finally:

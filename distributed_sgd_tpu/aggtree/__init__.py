@@ -1,0 +1,31 @@
+"""Aggregation-tree plane (docs/AGGREGATION.md, DSGD_AGG_TREE).
+
+The parameter-server -> hierarchical-reduction step (Li et al.'s PS
+architecture generalized to tree aggregation, as in hierarchical
+all-reduce systems): workers elected as reduce nodes psum their subtree's
+gradient replies before ONE upstream send, so the master terminates
+O(fanout) payloads per round instead of O(N) — the in-host psum of
+parallel/hier.py lifted to the cross-host RPC plane.
+
+Two modules:
+
+- ``plan``   — the deterministic tree builder: a pure function of
+  (registration-ordered membership, fanout, seed) -> reduce tree, with
+  host-locality grouping so a multi-worker host aggregates its own
+  subtree first.  Rebuilt by the master on ANY membership change, via
+  the same resplit hook the elastic plane fires.
+- ``reduce`` — the worker-side reduce-node role: buffered child pushes,
+  the canonical-order jitted f32 accumulate, and the upstream
+  AggregateGrad send with flat (direct-to-master) fallback.
+
+Everything is behind ``DSGD_AGG_TREE=fanout:F`` and default-off: with
+the knob unset no plan is ever built, no reducer constructed, no
+instrument registered, and the wire stays byte-identical to the flat
+engine (asserted by tests/test_aggtree.py).
+"""
+
+from distributed_sgd_tpu.aggtree.plan import (  # noqa: F401
+    TreePlan,
+    build_plan,
+    parse_agg_tree,
+)

@@ -1,0 +1,189 @@
+"""Deterministic reduce-tree builder (docs/AGGREGATION.md).
+
+``build_plan`` is a PURE function of (registration-ordered membership,
+fanout, seed): every process that sees the same membership list computes
+the byte-identical tree (asserted via ``TreePlan.digest`` by
+tests/test_aggtree.py), so the master can rebuild it on any membership
+change without a coordination round — the same property the split
+functions (core/split.py) rely on.
+
+Shape: the master is the root; the member list is grouped by HOST in
+first-appearance order (a HostMeshEngine host aggregates its own rows
+before anything crosses the rack, mirroring the host-granular splits of
+docs/HIERARCHY.md), each group deterministically rotated by the seed so
+aggregator election does not always tax the first-registered worker,
+and the concatenated order is carved into contiguous chunks: the first
+element of each chunk is elected aggregator for the rest, recursively,
+giving O(log_F N) depth with every interior node holding <= F children.
+N <= F degenerates to the flat topology — every worker is a root child
+with no children of its own, and the master's request annotation
+becomes a no-op (the knobs-on wire is then byte-identical to flat by
+construction).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+Key = Tuple[str, int]
+
+
+def parse_agg_tree(spec: Optional[str]) -> int:
+    """DSGD_AGG_TREE grammar -> fanout (0 = off).
+
+    Accepts None/"" (off) or "fanout:F" with integer F >= 2.  The strict
+    grammar is the config-validation contract: config.py delegates here
+    so a typo fails at startup, not mid-fit."""
+    if not spec:
+        return 0
+    parts = str(spec).split(":")
+    if len(parts) != 2 or parts[0] != "fanout":
+        raise ValueError(
+            f"DSGD_AGG_TREE must be 'fanout:F' (F >= 2), got {spec!r}")
+    try:
+        fanout = int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"DSGD_AGG_TREE fanout must be an integer, got {parts[1]!r}")
+    if fanout < 2:
+        raise ValueError(
+            f"DSGD_AGG_TREE fanout must be >= 2, got {fanout}")
+    return fanout
+
+
+class TreePlan:
+    """One immutable reduce tree over a membership snapshot.
+
+    ``parent[k]`` is None for root children (they reply their subtree
+    sum straight to the master); ``children[k]`` is the CANONICAL
+    accumulation order for k's reduce (float addition is
+    order-sensitive — two runs over the same plan must chain the same
+    order to land on byte-identical sums).  ``height[k]`` is the edge
+    count to k's deepest leaf (0 = leaf), which the master scales each
+    node's child-wait budget by so deep subtrees cascade inside the
+    round deadline."""
+
+    def __init__(self, fanout: int, keys: Sequence[Key],
+                 parent: Dict[Key, Optional[Key]],
+                 children: Dict[Key, Tuple[Key, ...]]):
+        self.fanout = int(fanout)
+        self.keys = tuple(keys)
+        self.parent = dict(parent)
+        self.children = dict(children)
+        self.root_children = tuple(
+            k for k in self.keys if self.parent[k] is None)
+        self.height: Dict[Key, int] = {}
+        for k in reversed(self.keys):  # children are always later in order
+            kids = self.children.get(k, ())
+            self.height[k] = (
+                1 + max(self.height[c] for c in kids) if kids else 0)
+        # master -> root child is one edge; depth counts the longest
+        # root-to-leaf edge chain (flat topology = 1)
+        self.depth = 1 + max(
+            (self.height[k] for k in self.root_children), default=0)
+        self.n_edges = sum(len(c) for c in self.children.values())
+
+    @property
+    def trivial(self) -> bool:
+        """No elected aggregators — the plan IS the flat topology."""
+        return self.n_edges == 0
+
+    def aggregators(self) -> List[Key]:
+        return [k for k in self.keys if self.children.get(k)]
+
+    def digest(self) -> str:
+        """sha256 over the canonical (fanout, edge list) JSON — the
+        cross-process byte-identity witness tests/test_aggtree.py pins."""
+        edges = [
+            [f"{k[0]}:{k[1]}",
+             "master" if self.parent[k] is None
+             else f"{self.parent[k][0]}:{self.parent[k][1]}"]
+            for k in self.keys
+        ]
+        blob = json.dumps({"fanout": self.fanout, "edges": edges},
+                          separators=(",", ":"), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def __repr__(self):
+        return (f"TreePlan(fanout={self.fanout}, n={len(self.keys)}, "
+                f"depth={self.depth}, edges={self.n_edges}, "
+                f"aggregators={len(self.aggregators())})")
+
+
+def _chunks(n: int, k: int) -> List[Tuple[int, int]]:
+    """[lo, hi) bounds of min(k, n) near-even contiguous chunks of
+    range(n) — sizes differ by at most one, larger chunks first (the
+    same carve rule as core/split.py's contiguous splits)."""
+    k = max(1, min(k, n))
+    base, rem = divmod(n, k)
+    out, lo = [], 0
+    for i in range(k):
+        hi = lo + base + (1 if i < rem else 0)
+        out.append((lo, hi))
+        lo = hi
+    return out
+
+
+def build_plan(keys: Sequence[Key], fanout: int, seed: int = 0,
+               hosts: Optional[Dict[Key, str]] = None) -> TreePlan:
+    """Membership snapshot -> deterministic reduce tree.
+
+    ``keys`` MUST be the registration-ordered member list (the master's
+    ``_order``); ``hosts`` optionally overrides each key's locality
+    label (defaults to key[0], the endpoint host).  Pure: no RNG state,
+    no wall clock — the seed enters only as a per-group rotation, so
+    every caller with the same inputs gets the identical tree."""
+    fanout = int(fanout)
+    if fanout < 2:
+        raise ValueError(f"fanout must be >= 2, got {fanout}")
+    keys = list(keys)
+    if len(set(keys)) != len(keys):
+        raise ValueError("duplicate member keys in tree membership")
+
+    # host-locality grouping, first-appearance order: one host's workers
+    # stay contiguous so its elected aggregator reduces its own rows
+    # before the sum crosses hosts
+    label = (hosts or {})
+    by_host: Dict[str, List[Key]] = {}
+    host_order: List[str] = []
+    for k in keys:
+        h = label.get(k, k[0])
+        if h not in by_host:
+            by_host[h] = []
+            host_order.append(h)
+        by_host[h].append(k)
+    ordered: List[Key] = []
+    for h in host_order:
+        group = by_host[h]
+        # deterministic rotation: spread aggregator election across the
+        # group instead of always taxing its first-registered worker
+        # (builtin hash() is process-randomized — never use it here)
+        rot = seed % len(group)
+        ordered.extend(group[rot:] + group[:rot])
+
+    parent: Dict[Key, Optional[Key]] = {}
+    children: Dict[Key, Tuple[Key, ...]] = {}
+
+    def carve(lo: int, hi: int, up: Optional[Key]) -> None:
+        """Split ordered[lo:hi) into <= fanout contiguous chunks; each
+        chunk's first element attaches to ``up`` and aggregates the
+        chunk's remainder recursively.  An empty range records nothing,
+        so leaves simply have no ``children`` entry."""
+        if lo >= hi:
+            return
+        heads = []
+        for clo, chi in _chunks(hi - lo, fanout):
+            head = ordered[lo + clo]
+            parent[head] = up
+            heads.append(head)
+            carve(lo + clo + 1, lo + chi, head)
+        if up is not None:
+            children[up] = tuple(heads)
+
+    if ordered:
+        carve(0, len(ordered), None)
+    # plan order = the carved ordered list (parents precede children,
+    # which TreePlan.height relies on)
+    return TreePlan(fanout, ordered, parent, children)

@@ -1,0 +1,213 @@
+"""Worker-side reduce-node role (docs/AGGREGATION.md, DSGD_AGG_TREE).
+
+An elected aggregator's Gradient servicer does three extra things per
+round, all driven by the request annotation the master stamps from its
+TreePlan (GradientRequest.agg_* fields — see rpc/proto/dsgd.proto):
+
+1. **Collect** its children's subtree sums: each child PUSHES its
+   encoded GradUpdate over the new Worker.AggregateGrad arm, and the
+   parent's in-flight Gradient handler waits on the round's buffer up
+   to the master-budgeted ``agg_wait_ms``.  Pushes may arrive BEFORE
+   the parent's own request (a fast child under a slow broadcast), so
+   the buffer is keyed (fit_token, agg_round) and bounded — stale
+   rounds (a retry bumped agg_round) age out instead of leaking.
+2. **Reduce** own gradient + children in CANONICAL child order (the
+   order the master stamped, which is the plan's child tuple): each
+   arm decodes through the shared codec (topk/qint8/sparse/dense — the
+   same per-edge compress/EF machinery as the flat wire), and the f32
+   accumulation runs as ONE jitted chain (lax.fori_loop over the child
+   stack — sequential adds, so the subtree sum is bit-deterministic
+   for a given plan and reply set).
+3. **Re-encode once upstream**: through the worker's own compressor
+   (per-edge error feedback — the aggregator's residual accumulates
+   against its SUBTREE sum) to its parent via AggregateGrad, or as the
+   direct Gradient reply when this node is a root child.  A failed
+   upstream push degrades to a direct-to-master reply tagged
+   ``agg_flat`` (flat fallback: the tree loses performance, never the
+   round); a missing child degrades to a partial sum tagged with the
+   contributor set, which the master averages honestly.
+
+Nothing in this module is constructed when DSGD_AGG_TREE is off: the
+Reducer is created lazily by the first agg-annotated request, so the
+knobs-off worker registers no aggtree instrument and allocates nothing
+(asserted by tests/test_aggtree.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+# bounded pending-round buffer: a retry bumps agg_round, a rebuilt tree
+# re-parents children mid-fit — either way pushes for abandoned rounds
+# must age out, not accumulate.  8 rounds is >= any plausible in-flight
+# window (one live round + stragglers of a handful of retries).
+MAX_PENDING_ROUNDS = 8
+
+
+class _Round:
+    """One (fit_token, agg_round) collection buffer."""
+
+    __slots__ = ("updates",)
+
+    def __init__(self):
+        self.updates: Dict[str, pb.GradUpdate] = {}
+
+
+class Reducer:
+    """Per-worker aggregation state + the reduce/push machinery.
+
+    Lives on WorkerNode as ``_agg``, created lazily on the first
+    agg-annotated request (knobs-off: never constructed)."""
+
+    def __init__(self, worker):
+        self.w = worker
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._rounds: "OrderedDict[Tuple[int, int], _Round]" = OrderedDict()
+        # per-child-count jitted accumulate chain (see _accum_fn)
+        self._accum_cache: Dict[int, callable] = {}
+        m = worker.metrics
+        self.children_counter = m.counter(metrics_mod.AGG_CHILDREN)
+        self.bytes_in = m.counter(metrics_mod.AGG_BYTES_IN)
+        self.bytes_up = m.counter(metrics_mod.AGG_BYTES_UP)
+
+    # -- child-push intake (Worker.AggregateGrad servicer body) ------------
+
+    def offer(self, fit_token: int, agg_round: int, origin: str,
+              update: pb.GradUpdate) -> None:
+        """Buffer one child's subtree sum and wake the collector.  Ages
+        the oldest round out past MAX_PENDING_ROUNDS — a push for a
+        round the parent already closed (or will never run: retries
+        bump agg_round) costs one dict entry until then, never a leak."""
+        self.bytes_in.increment(update.ByteSize())
+        key = (int(fit_token), int(agg_round))
+        with self._cv:
+            rnd = self._rounds.get(key)
+            if rnd is None:
+                while len(self._rounds) >= MAX_PENDING_ROUNDS:
+                    self._rounds.popitem(last=False)
+                rnd = self._rounds[key] = _Round()
+            rnd.updates[origin] = update
+            self._cv.notify_all()
+
+    def collect(self, fit_token: int, agg_round: int,
+                children: Sequence[str],
+                wait_s: float) -> Dict[str, pb.GradUpdate]:
+        """Wait up to ``wait_s`` for every child in ``children``; returns
+        whatever arrived (the caller tags the reply partial on a miss).
+        The round's buffer is consumed — a late push re-creates it and
+        ages out."""
+        import time as _time
+
+        key = (int(fit_token), int(agg_round))
+        want = set(children)
+        t_end = _time.monotonic() + max(0.0, wait_s)
+        with self._cv:
+            while True:
+                rnd = self._rounds.get(key)
+                got = rnd.updates if rnd is not None else {}
+                if want.issubset(got.keys()):
+                    break
+                remaining = t_end - _time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cv.wait(timeout=min(remaining, 0.25))
+            out = {c: got[c] for c in children if c in got}
+            self._rounds.pop(key, None)
+            return out
+
+    # -- canonical-order reduce --------------------------------------------
+
+    def _accum_fn(self, n: int):
+        """Jitted f32 accumulate of ``n`` child vectors onto the own
+        gradient, in stack order: a lax.fori_loop of sequential
+        elementwise adds — the SAME IEEE f32 chain a numpy loop would
+        run, compiled once per child count (<= fanout distinct shapes),
+        off the GIL on real accelerators."""
+        if n not in self._accum_cache:
+
+            def fn(acc, stack):
+                def body(i, a):
+                    return a + stack[i]
+
+                return jax.lax.fori_loop(0, n, body, acc)
+
+            self._accum_cache[n] = jax.jit(fn)
+        return self._accum_cache[n]
+
+    def reduce(self, own: np.ndarray,
+               updates: List[pb.GradUpdate]) -> np.ndarray:
+        """own + sum(updates) in list order (the canonical child order
+        the caller built from the request annotation)."""
+        if not updates:
+            return own
+        self.children_counter.increment(len(updates))
+        stack = np.stack([codec.decode_grad(u) for u in updates])
+        acc = self._accum_fn(len(updates))(
+            jnp.asarray(own, dtype=jnp.float32), jnp.asarray(stack))
+        return np.asarray(acc)
+
+    # -- upstream push ------------------------------------------------------
+
+    def push_up(self, parent: str, fit_token: int, agg_round: int,
+                msg: pb.GradUpdate) -> bool:
+        """Send the subtree sum to ``parent`` ("host:port") over
+        AggregateGrad; returns False on ANY failure — breaker
+        suppressed, channel gone, deadline, UNIMPLEMENTED (skewed
+        binary) — and the caller replies direct-to-master instead
+        (flat fallback).  Outcomes feed the per-edge breaker, so a
+        dead parent costs one probe per cooldown, not a deadline per
+        round."""
+        host, _, port_s = parent.rpartition(":")
+        try:
+            pkey = (host, int(port_s))
+        except ValueError:
+            return False
+        w = self.w
+        # parent stubs come from the SAME peer table the gossip plane
+        # maintains (master-introduced full mesh); a parent missing from
+        # it (e.g. this worker joined after the introductions) is added
+        # on first use — new_channel, so chaos edge faults compose
+        with w._peers_lock:
+            stub = w._peers.get(pkey)
+        if stub is None:
+            w.add_peer(*pkey)
+            with w._peers_lock:
+                stub = w._peers.get(pkey)
+            if stub is None:
+                return False
+        breaker = w.rpc_policy.breaker(pkey)
+        if not breaker.allow():
+            return False
+        req = pb.AggGrad(fit_token=int(fit_token), round=int(agg_round),
+                         origin=w.node_label)
+        req.update.CopyFrom(msg)
+        try:
+            stub.AggregateGrad(req, timeout=w.rpc_policy.deadline_s)
+        except Exception as e:  # noqa: BLE001 - any failure -> flat fallback
+            breaker.record_failure()
+            flight.record("agg.push.failed", worker=w.node_label,
+                          parent=parent, error=repr(e))
+            return False
+        breaker.record_ok()
+        self.bytes_up.increment(req.ByteSize())
+        return True
+
+
+def wait_budget_s(request) -> float:
+    """The child-wait budget for this node's collect, from the master's
+    per-request stamp (agg_wait_ms scales with subtree height so deep
+    chains cascade inside the round deadline); a missing stamp (older
+    master) falls back to the control-plane deadline."""
+    ms = int(getattr(request, "agg_wait_ms", 0) or 0)
+    return ms / 1000.0 if ms > 0 else 5.0

@@ -200,6 +200,11 @@ class Config:
     # request build (weight arm attached) leave the dispatch critical
     # path, for stream and unary fits alike.
     stage_pool: int = 0
+    # aggregation tree (aggtree/, docs/AGGREGATION.md): "fanout:F" elects
+    # sub-aggregator reduce nodes so the master's fan-in terminates
+    # O(F) subtree sums instead of O(N) replies.  "" (default): flat
+    # fan-in — no plan built, no reducer constructed, wire byte-identical.
+    agg_tree: str = ""
     # tensor parallelism: shard the blocked weight rows over F feature
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
@@ -373,6 +378,11 @@ class Config:
             from distributed_sgd_tpu.chaos import parse_plan
 
             parse_plan(self.chaos)
+        if self.agg_tree:
+            # same discipline: the tree grammar is owned by aggtree.plan
+            from distributed_sgd_tpu.aggtree import parse_agg_tree
+
+            parse_agg_tree(self.agg_tree)
         # fail topology typos at construction; grammar owned by
         # parallel/topology.parse_topology
         from distributed_sgd_tpu.parallel.topology import parse_topology
@@ -652,6 +662,7 @@ class Config:
             stream=_env("DSGD_STREAM", cls.stream, bool),
             fanin_lanes=_env("DSGD_FANIN_LANES", cls.fanin_lanes, int),
             stage_pool=_env("DSGD_STAGE_POOL", cls.stage_pool, int),
+            agg_tree=_env("DSGD_AGG_TREE", cls.agg_tree, str),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
             host_devices=_env("DSGD_HOST_DEVICES", cls.host_devices, int),
             compile_cache=_env("DSGD_COMPILE_CACHE", None, str),

@@ -844,6 +844,9 @@ class MasterNode:
         # explicitly; tests and embedders may pin the attributes instead)
         self.fanin_lanes = 0
         self.stage_pool = 0
+        # aggregation-tree plane default (DSGD_AGG_TREE, docs/AGGREGATION.md):
+        # "" = flat fan-in; "fanout:F" elects sub-aggregator reduce nodes
+        self.agg_tree = ""
 
         self._workers: Dict[Tuple[str, int], WorkerStub] = {}
         self._channels: Dict[Tuple[str, int], grpc.Channel] = {}
@@ -1570,6 +1573,51 @@ class MasterNode:
         bound = self._eval_test if test else self._eval_train
         return bound.evaluate(jnp.asarray(weights, dtype=jnp.float32))
 
+    # -- aggregation tree (aggtree/, docs/AGGREGATION.md) --------------------
+
+    def _build_tree_plan(self, keys, fanout: int):
+        """Deterministic reduce tree over the current member list
+        (aggtree/plan.py — pure, so every rebuild at the same membership
+        lands on the byte-identical plan).  Called only with
+        DSGD_AGG_TREE set; registers the tree gauges, which is why the
+        knobs-off path must never reach here (tests/test_aggtree.py)."""
+        from distributed_sgd_tpu.aggtree import build_plan
+
+        plan = build_plan(keys, fanout, seed=self.seed)
+        self.metrics.gauge(metrics_mod.TREE_DEPTH).set(plan.depth)
+        self.metrics.gauge(metrics_mod.TREE_EDGES).set(plan.n_edges)
+        flight.record("tree.plan", members=len(keys), fanout=int(fanout),
+                      depth=plan.depth, edges=plan.n_edges,
+                      aggregators=len(plan.aggregators()),
+                      digest=plan.digest()[:12])
+        self.log.info("aggregation tree: %r", plan)
+        return plan
+
+    @staticmethod
+    def _annotate_tree(req, key, plan, agg_round: int,
+                       grad_timeout_s: float) -> None:
+        """Stamp one worker's GradientRequest with its tree role.  A
+        worker that is a root child with no children gets NO stamp at
+        all — its request (and reply) is byte-identical to the flat
+        wire, which is also why a trivial plan annotates nothing."""
+        parent = plan.parent.get(key)
+        kids = plan.children.get(key, ())
+        if parent is None and not kids:
+            return
+        if parent is not None:
+            req.agg_parent = f"{parent[0]}:{parent[1]}"
+        req.agg_round = int(agg_round)
+        if kids:
+            del req.agg_children[:]
+            req.agg_children.extend(f"{c[0]}:{c[1]}" for c in kids)
+            # child-wait budget scaled by subtree height: the deepest
+            # nodes time out first, so partial sums cascade bottom-up
+            # inside ~60% of the master's round deadline instead of
+            # every level burning the full budget serially
+            slice_s = 0.6 * float(grad_timeout_s) / max(1, plan.depth)
+            req.agg_wait_ms = max(1, int(
+                1000.0 * plan.height.get(key, 1) * slice_s))
+
     # -- sync fit (Master.scala:120-218) -----------------------------------
 
     def fit_sync(
@@ -1598,6 +1646,7 @@ class MasterNode:
         stream: bool = False,
         fanin_lanes: Optional[int] = None,
         stage_pool: Optional[int] = None,
+        agg_tree: Optional[str] = None,
     ) -> FitResult:
         """Fault-tolerant sync fit, with an optional pipelined wire path.
 
@@ -1744,9 +1793,22 @@ class MasterNode:
         lanes = max(0, int(lanes))
         pool_n = (self.stage_pool if stage_pool is None else int(stage_pool))
         stager = _DispatchStager(pool_n) if pool_n and pool_n > 0 else None
+        # aggregation-tree plane (DSGD_AGG_TREE, docs/AGGREGATION.md): the
+        # fanout resolves against the node attribute like the knobs above;
+        # 0/"" = flat fan-in — no plan is ever built, no tree instrument
+        # registered, the wire byte-identical (tests/test_aggtree.py)
+        tree_spec = (self.agg_tree if agg_tree is None else agg_tree) or ""
+        tree_fanout = 0
+        if tree_spec:
+            from distributed_sgd_tpu.aggtree import parse_agg_tree
+
+            tree_fanout = parse_agg_tree(tree_spec)
+        tree_plan = None
         self._require_ready()
         members = self._members()
         keys = [k for k, _ in members]
+        if tree_fanout:
+            tree_plan = self._build_tree_plan(keys, tree_fanout)
         parts = self._split_parts(split, members)
         max_samples = max(len(p) for p in parts)
         w = (
@@ -1797,6 +1859,11 @@ class MasterNode:
         # barrier discarded — the NEXT request to that worker carries it so
         # the worker rolls back its EF residual drain for the skipped round
         ef_rollback: Dict[Tuple[str, int], int] = {}
+        # per-ATTEMPT tree round (DSGD_AGG_TREE): bumped on every fan-out,
+        # retries included, so a stale child push from an abandoned attempt
+        # keys a round its parent will never collect — it ages out of the
+        # aggregator's bounded buffer instead of double-counting
+        agg_round_seq = 0
         stalled = self.metrics.counter(metrics_mod.SYNC_STALLED)
         # training-health monitor (telemetry/health.py): inert when None
         if (health is not None and health.action != "warn"
@@ -1955,6 +2022,18 @@ class MasterNode:
                         members, keys = current, [k for k, _ in current]
                         parts = self._split_parts(split, members)
                         max_samples = max(len(p) for p in parts)
+                        if tree_fanout:
+                            # the reduce tree is a pure function of the
+                            # member list: rebuild it on the SAME hook the
+                            # resplit fires, so plan and split always
+                            # describe the same membership snapshot
+                            tree_plan = self._build_tree_plan(
+                                keys, tree_fanout)
+                            self.metrics.counter(
+                                metrics_mod.TREE_REBUILDS).increment()
+                            flight.record("tree.rebuild",
+                                          members=len(keys),
+                                          depth=tree_plan.depth)
                         bcast.forget_missing(keys)  # rejoins start from full
                         if use_stream or stager is not None:
                             # re-arm staging for the new membership; departed
@@ -1990,6 +2069,7 @@ class MasterNode:
                                             formulation=scatter_form)
                             scatter_evented = True
                         futs = []
+                        agg_round_seq += 1  # fresh tree round per attempt
                         ids_by_key: Dict[Tuple[str, int], np.ndarray] = {}
                         rb_sent: Dict[Tuple[str, int], int] = {}
                         # overlapped fan-in (full barrier only): zero the
@@ -2051,6 +2131,16 @@ class MasterNode:
                                     req.batch_size = batch_size
                                     req.learning_rate = learning_rate
                                 bcast.populate(req, key, w)
+                            if tree_plan is not None and not tree_plan.trivial:
+                                # stamp this worker's tree role (parent /
+                                # children / wait budget) from the plan —
+                                # staged requests and stream frames are
+                                # mutated in place, so the annotation rides
+                                # every transport; a trivial plan (N <= F)
+                                # stamps nothing and the wire stays flat
+                                self._annotate_tree(req, key, tree_plan,
+                                                    agg_round_seq,
+                                                    grad_timeout_s)
                             rb = ef_rollback.pop(key, None)
                             if rb is not None:
                                 req.ef_rollback_version = rb
@@ -2170,7 +2260,33 @@ class MasterNode:
                             grad_acc.fill(0.0)
                             for reply in replies:
                                 codec.decode_grad_into(reply, grad_acc)
-                        grad_acc /= len(replies)  # true divide, bit-matching np.mean
+                        if tree_plan is not None and not tree_plan.trivial:
+                            # tree fan-in: each reply is either a subtree
+                            # sum tagged with its exact contributor set, a
+                            # flat-fallback payload (dead parent), or an
+                            # armless agg_forwarded ack (decodes as zero,
+                            # contributes nothing) — the mean divides by
+                            # the TOTAL contributors, so a partial round
+                            # (missed child push) still averages honestly
+                            n_contrib = 0
+                            for r in replies:
+                                if r.agg_contributors:
+                                    n_contrib += len(r.agg_contributors)
+                                elif not r.agg_forwarded:
+                                    # flat reply inside a tree round (e.g.
+                                    # a quorum hedge, or a worker absent
+                                    # from the plan): one contributor
+                                    n_contrib += 1
+                                if r.agg_partial:
+                                    self.metrics.counter(
+                                        metrics_mod.TREE_PARTIAL).increment()
+                                if r.agg_flat:
+                                    self.metrics.counter(
+                                        metrics_mod.TREE_FLAT_FALLBACK
+                                    ).increment()
+                            grad_acc /= max(1, n_contrib)
+                        else:
+                            grad_acc /= len(replies)  # true divide, bit-matching np.mean
                         if health is not None:
                             # NaN/Inf sentinel: a non-finite fan-in NEVER
                             # reaches the weights, whatever the action — the

@@ -293,6 +293,13 @@ class WorkerNode:
         self._apply = jax.jit(lambda w, d: w - d)
         self._grad_cache: Dict[int, callable] = {}  # keyed by padded capacity
 
+        # aggregation-tree reduce role (aggtree/reduce.py, DSGD_AGG_TREE):
+        # constructed lazily by the FIRST agg-annotated request so a
+        # knobs-off worker registers no aggtree instrument and allocates
+        # nothing (tests/test_aggtree.py identity gate)
+        self._agg = None
+        self._agg_lock = threading.Lock()
+
         # DSGD_PROFILE_DIR on the RPC worker role: a jax.profiler capture
         # of the FIRST `profile_steps` device dispatches (Gradient bodies
         # or async-loop steps) — this is where the distributed wall-clock
@@ -312,6 +319,19 @@ class WorkerNode:
     def node_label(self) -> str:
         """Stable identity for trace spans and flight events."""
         return f"{self.host}:{self.port}"
+
+    def _ensure_reducer(self):
+        """Lazily construct the aggregation-tree reduce role
+        (aggtree/reduce.py) on the first agg-annotated request or child
+        push — a knobs-off worker never calls this, so it registers no
+        aggtree instrument (tests/test_aggtree.py identity gate)."""
+        if self._agg is None:
+            with self._agg_lock:
+                if self._agg is None:
+                    from distributed_sgd_tpu.aggtree.reduce import Reducer
+
+                    self._agg = Reducer(self)
+        return self._agg
 
     # resident-slice views (read-only; the canonical state is the atomic
     # _Resident snapshot — dispatch paths grab the snapshot ONCE and use
@@ -696,6 +716,53 @@ class WorkerNode:
         g = self._grad_fn(len(pids))(
             jnp.asarray(w), res.idx, res.val, res.y, pids, valid
         )
+        self.metrics.counter("slave.sync.backward").increment()
+        return np.asarray(g)
+
+    def compute_gradient_hedged(self, w: np.ndarray,
+                                ids: np.ndarray) -> np.ndarray:
+        """Hedge-request compute (GradientRequest.hedge): same math as
+        compute_gradient, but a FOREIGN slice — ids outside a host-local
+        donor's resident window — is read through the donor's RowReader
+        into a transient scratch batch instead of sliding the resident
+        window via ensure_rows.  The donor's resident bounds, reload
+        counters, and over-provision budget belong to ITS OWN slice; a
+        backup duplicate of someone else's rows must not thrash them
+        (docs/HIERARCHY.md — the caveat that used to ban hedge=True in
+        bench_soak).  Ids inside the resident slice take the normal path
+        unchanged, so a full-corpus worker never pays anything here."""
+        res = self._resident
+        if (res.offset is not None and self._row_reader is not None
+                and len(ids)):
+            local = np.asarray(ids, dtype=np.int64) - res.offset
+            if local.min() < 0 or local.max() >= res.n:
+                return self._scratch_gradient(w, ids, res)
+        return self.compute_gradient(w, ids)
+
+    def _scratch_gradient(self, w: np.ndarray, ids: np.ndarray,
+                          res: "_Resident") -> np.ndarray:
+        """Bounded scratch read + one gradient over it: materializes ONLY
+        [min(ids), max(ids)+1) through the RowReader — the same clipped
+        window ensure_rows would have requested, WITHOUT the
+        over-provision margin, the resident-budget union, the _Resident
+        swap, or the reload counters/flight record — computes on the
+        transient arrays, and drops them."""
+        from distributed_sgd_tpu.data import host_shard
+
+        self._profile.tick()
+        gmin = int(np.min(ids))
+        gmax = int(np.max(ids)) + 1
+        host = res.host
+        scratch = host_shard.load_host_shard(
+            self._row_reader, self._total_rows, host.n_features,
+            host.pad_width if not host.is_dense else 0, gmin, gmax,
+            labels_dtype=host.labels.dtype)
+        self.metrics.counter(metrics_mod.HEDGE_SCRATCH).increment()
+        pids, valid = self._pad_ids(np.asarray(ids, dtype=np.int64) - gmin)
+        g = self._grad_fn(len(pids))(
+            jnp.asarray(w), jnp.asarray(scratch.indices),
+            jnp.asarray(scratch.values), jnp.asarray(scratch.labels),
+            pids, valid)
         self.metrics.counter("slave.sync.backward").increment()
         return np.asarray(g)
 
@@ -1212,6 +1279,11 @@ class _WorkerServicer:
             if k > 1:
                 g = self.w.compute_local_window(
                     w, ids, k, request.batch_size, request.learning_rate)
+            elif request.hedge:
+                # foreign-slice hedges read through a bounded scratch so
+                # the donor's resident window never slides for someone
+                # else's rows (see compute_gradient_hedged)
+                g = self.w.compute_gradient_hedged(w, ids)
             else:
                 g = self.w.compute_gradient(w, ids)
         if request.hedge:
@@ -1231,6 +1303,21 @@ class _WorkerServicer:
             return msg
         if self.w.telemetry:
             self.w.record_health(g)
+        if request.agg_parent or request.agg_children:
+            # aggregation tree (DSGD_AGG_TREE, docs/AGGREGATION.md): this
+            # node is an elected reduce node and/or an interior child —
+            # collect, reduce, and route the subtree sum instead of the
+            # plain reply.  Flat requests never reach this branch, so the
+            # knobs-off dispatch path pays one falsy proto-field read.
+            return self._agg_gradient(request, g, k)
+        return self._encode_reply(request, g, k)
+
+    def _encode_reply(self, request, g, k):
+        """The sync-reply encode tail, shared by the flat path and the
+        tree path (a subtree sum rides the SAME per-edge codec /
+        compression / EF machinery as a flat reply — for an aggregator
+        the error-feedback residual simply accumulates against its
+        subtree sum instead of its own gradient)."""
         # sync fan-in reply: compressed when configured (EF residual keyed
         # to the one sync destination — this worker answers one master),
         # with the retry-rollback + fit-session guards of encode_sync_grad
@@ -1249,6 +1336,72 @@ class _WorkerServicer:
         if k > 1:
             msg.n_steps = k  # wire accounting: steps amortized per round
         return msg
+
+    def _agg_gradient(self, request, g, k):
+        """Tree-annotated Gradient body (docs/AGGREGATION.md): reduce the
+        stamped children into this node's own gradient in CANONICAL
+        (stamped) order, then either push the subtree sum to the stamped
+        parent over AggregateGrad (reply = armless agg_forwarded ack) or
+        reply it to the master directly (root child — and the flat
+        fallback when the push fails, tagged agg_flat).  Either way the
+        encode tail below runs EXACTLY once per round, so the per-edge
+        error-feedback residual drains at most once per round too."""
+        from distributed_sgd_tpu.aggtree import reduce as agg_reduce
+
+        red = self.w._ensure_reducer()
+        contributors = [self.w.node_label]
+        partial = False
+        if request.agg_children:
+            children = list(request.agg_children)
+            with measure.span("slave.agg.reduce", metrics=self.w.metrics,
+                              root=False, children=len(children)):
+                got = red.collect(request.fit_token, request.agg_round,
+                                  children,
+                                  agg_reduce.wait_budget_s(request))
+                # canonical order: the stamped child tuple, misses skipped
+                # (f32 addition is order-sensitive — two runs over the same
+                # plan and reply set must chain identically)
+                updates = [got[c] for c in children if c in got]
+                g = red.reduce(np.asarray(g, dtype=np.float32), updates)
+            for c in children:
+                u = got.get(c)
+                if u is None:
+                    partial = True
+                else:
+                    contributors.extend(u.agg_contributors or [c])
+        msg = self._encode_reply(request, g, k)
+        msg.agg_contributors.extend(contributors)
+        if partial:
+            msg.agg_partial = True
+            self.w.metrics.counter(metrics_mod.AGG_PARTIAL).increment()
+        if request.agg_parent:
+            if red.push_up(request.agg_parent, request.fit_token,
+                           request.agg_round, msg):
+                # the subtree sum is riding the tree — the master's
+                # barrier still gets one reply per dispatched worker,
+                # this armless ack (decodes as zero, see codec.parse_grad)
+                return pb.GradUpdate(agg_forwarded=True)
+            # dead/unreachable parent: this whole subtree degrades to a
+            # direct-to-master send for THIS round (the tree loses
+            # performance, never the round).  Counted HERE, not at the
+            # master: a dead parent usually fails its own reply in the
+            # same window, so the master retries and discards the very
+            # replies that carried the fallback flag — the child is the
+            # only node that reliably witnesses the degradation.
+            self.w.metrics.counter(metrics_mod.AGG_FLAT).increment()
+            msg.agg_flat = True
+            flight.record("agg.flat_fallback", worker=self.w.node_label,
+                          parent=request.agg_parent,
+                          round=int(request.agg_round))
+        return msg
+
+    def AggregateGrad(self, request, context):  # noqa: N802
+        """Tree child push intake (DSGD_AGG_TREE): buffer the child's
+        encoded subtree sum for the in-flight (or imminent) Gradient
+        body above — see aggtree/reduce.py for the buffer contract."""
+        self.w._ensure_reducer().offer(request.fit_token, request.round,
+                                       request.origin, request.update)
+        return pb.Ack()
 
     def FitStream(self, request_iterator, context):  # noqa: N802
         """Streaming sync fan-out (DSGD_STREAM, docs/SYNC_PIPELINE.md):

@@ -205,6 +205,12 @@ def parse_grad(g: pb.GradUpdate):
             raise ValueError(
                 f"unknown CompressedGrad codec {g.compressed.codec!r}")
         return ("add", _qint8_values(g.compressed))
+    if which is None and not g.dense.size:
+        # armless update: an aggregation-tree child that PUSHED its
+        # gradient to its parent acks the master with no payload
+        # (GradUpdate.agg_forwarded, docs/AGGREGATION.md) — it
+        # contributes nothing to the accumulator, not an empty vector
+        return ("zero",)
     return ("add", np.frombuffer(g.dense.data, dtype="<f4", count=g.dense.size))
 
 

@@ -260,6 +260,11 @@ _WORKER_METHODS = {
     # without the method answers UNIMPLEMENTED, which the scraper treats
     # as a degraded-but-non-fatal miss
     "Metrics": (pb.Empty, pb.MetricsSnapshot),
+    # aggregation-tree child push (DSGD_AGG_TREE, docs/AGGREGATION.md):
+    # a tree child delivers its encoded subtree sum to its elected
+    # parent; an older binary answers UNIMPLEMENTED, the push fails, and
+    # the child replies direct-to-master tagged agg_flat (flat fallback)
+    "AggregateGrad": (pb.AggGrad, pb.Ack),
 }
 
 # Bidirectional streaming surface (DSGD_STREAM, docs/SYNC_PIPELINE.md):
@@ -290,7 +295,8 @@ _SERVE_METHODS = {
 # Methods a servicer may legitimately lack (older binaries, partial test
 # stubs): absent -> no handler -> UNIMPLEMENTED to callers.  Everything
 # else is required and fails server construction when missing.
-_OPTIONAL_METHODS = frozenset({"Metrics", "PushWeights", "FitStream"})
+_OPTIONAL_METHODS = frozenset(
+    {"Metrics", "PushWeights", "FitStream", "AggregateGrad"})
 
 
 def _traced_handler(fn, method: str, node: Optional[str]):

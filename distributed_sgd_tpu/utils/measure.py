@@ -37,6 +37,7 @@ log = logging.getLogger("dsgd.measure")
 SPAN_NAME_ALLOWLIST = frozenset({
     "slave.grad.compute",
     "slave.grad.encode",
+    "slave.agg.reduce",
     "slave.async.gossip",
     "serve.predict.decode",
     "serve.predict.queue",

@@ -531,6 +531,26 @@ COMPILE_WARMUP_ERRORS = "compile.warmup.errors"  # counter: thunks that failed (
 DATA_RELOADS = "slave.data.reloads"              # counter: resident-slice reloads
 DATA_RELOAD_ROWS = "slave.data.reload.rows"      # counter: rows read for reloads
 SYNC_RESPLITS = "master.sync.resplit"            # counter: mid-fit membership resplits
+# hedged requests for a FOREIGN slice served from a bounded scratch read
+# through the donor's RowReader (never ensure_rows — the donor's resident
+# window must not slide for someone else's data; docs/HIERARCHY.md)
+HEDGE_SCRATCH = "slave.data.hedge.scratch"       # counter: scratch-served hedges
+
+
+# -- aggregation tree (aggtree/; docs/AGGREGATION.md) -------------------------
+# Registered only when DSGD_AGG_TREE stamps a non-trivial plan: the master
+# side on the first plan build, the worker side when its Reducer is lazily
+# constructed — knobs-off, none of these exist (tests/test_aggtree.py).
+TREE_DEPTH = "master.tree.depth"                 # gauge: longest root-to-leaf edge chain
+TREE_EDGES = "master.tree.edges"                 # gauge: worker->worker edges in the plan
+TREE_PARTIAL = "master.tree.partial"             # counter: partial subtree sums accepted
+TREE_FLAT_FALLBACK = "master.tree.flat_fallback"  # counter: replies that bypassed a dead parent
+TREE_REBUILDS = "master.tree.rebuilds"           # counter: mid-fit plan rebuilds
+AGG_CHILDREN = "slave.agg.children"              # counter: child updates reduced here
+AGG_BYTES_IN = "slave.agg.bytes_in"              # counter: child push bytes received
+AGG_BYTES_UP = "slave.agg.bytes_up"              # counter: bytes pushed to the parent
+AGG_PARTIAL = "slave.agg.partial"                # counter: reduced rounds missing a child
+AGG_FLAT = "slave.agg.flat"                      # counter: dead-parent flat fallbacks (child side)
 
 
 # which sparse-scatter formulation the process's kernels run (DSGD_SCATTER,

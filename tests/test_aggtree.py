@@ -1,0 +1,444 @@
+"""Aggregation-tree plane (ISSUE 17, docs/AGGREGATION.md, DSGD_AGG_TREE).
+
+Correctness story under test: the reduce tree is a PURE function of the
+registration-ordered membership (byte-identical plan — and digest —
+across processes); the master rebuilds it on the same hook the resplit
+fires, so churn lands within one round; an aggregator that cannot reach
+its parent degrades to a direct-to-master reply for exactly that round
+(flat fallback — the tree loses performance, never the round); and with
+the knob off no plan is ever built, no aggtree instrument registered,
+and the wire stays byte-identical to the flat fan-in.
+"""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.aggtree import build_plan, parse_agg_tree
+from distributed_sgd_tpu.aggtree.plan import TreePlan, _chunks
+from distributed_sgd_tpu.aggtree.reduce import (
+    MAX_PENDING_ROUNDS,
+    Reducer,
+    wait_budget_s,
+)
+from distributed_sgd_tpu.core.cluster import DevCluster
+from distributed_sgd_tpu.data.rcv1 import dim_sparsity, train_test_split
+from distributed_sgd_tpu.data.synthetic import rcv1_like
+from distributed_sgd_tpu.models.linear import make_model
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.utils import metrics as mm
+
+
+@pytest.fixture(scope="module")
+def data():
+    return train_test_split(
+        rcv1_like(320, n_features=128, nnz=8, noise=0.0, seed=51,
+                  idf_values=True))
+
+
+@pytest.fixture(scope="module")
+def model_fn(data):
+    train, _ = data
+    ds = dim_sparsity(train)
+    return lambda: make_model("hinge", 1e-5, train.n_features,
+                              dim_sparsity=ds)
+
+
+def _fit(cluster, **kw):
+    kw.setdefault("max_epochs", 2)
+    kw.setdefault("batch_size", 16)
+    kw.setdefault("learning_rate", 0.5)
+    return cluster.master.fit_sync(**kw)
+
+
+def _keys(n, host="10.0.0.1"):
+    return [(host, 7000 + i) for i in range(n)]
+
+
+# -- 1. the plan is a pure function of membership ---------------------------
+
+
+def test_parse_agg_tree_grammar():
+    assert parse_agg_tree(None) == 0
+    assert parse_agg_tree("") == 0
+    assert parse_agg_tree("fanout:2") == 2
+    assert parse_agg_tree("fanout:16") == 16
+    for bad in ("fanout", "fanout:", "fanout:1", "fanout:0", "fanout:-3",
+                "fanout:2:3", "tree:4", "fanout:two"):
+        with pytest.raises(ValueError):
+            parse_agg_tree(bad)
+
+
+def test_chunks_partition_is_contiguous_and_near_even():
+    for n in range(1, 40):
+        for k in range(1, 9):
+            spans = _chunks(n, k)
+            assert spans[0][0] == 0 and spans[-1][1] == n
+            for (a, b), (c, d) in zip(spans, spans[1:]):
+                assert b == c  # contiguous, no gaps
+            sizes = [hi - lo for lo, hi in spans]
+            assert max(sizes) - min(sizes) <= 1
+
+
+def test_plan_structure_invariants():
+    keys = _keys(13)
+    plan = build_plan(keys, 3, seed=7)
+    # every member appears exactly once, parents precede children
+    assert sorted(plan.keys) == sorted(keys)
+    pos = {k: i for i, k in enumerate(plan.keys)}
+    for k, kids in plan.children.items():
+        assert len(kids) <= 3
+        for c in kids:
+            assert plan.parent[c] == k
+            assert pos[c] > pos[k]
+    # root children reply straight to the master
+    for k in plan.root_children:
+        assert plan.parent[k] is None
+    assert len(plan.root_children) <= 3
+    assert plan.n_edges == len(keys) - len(plan.root_children)
+    assert plan.depth >= 2 and not plan.trivial
+    # heights: leaf 0, parent = 1 + max(child)
+    for k in plan.keys:
+        kids = plan.children.get(k, ())
+        want = 1 + max(plan.height[c] for c in kids) if kids else 0
+        assert plan.height[k] == want
+
+
+def test_small_membership_degenerates_to_flat():
+    for n in (1, 2, 3):
+        plan = build_plan(_keys(n), 3, seed=5)
+        assert plan.trivial
+        assert plan.n_edges == 0
+        assert len(plan.root_children) == n
+        assert plan.aggregators() == []
+        assert plan.depth == 1 if n else True
+
+
+def test_plan_deterministic_and_seed_rotates_election():
+    keys = _keys(16)
+    a = build_plan(keys, 4, seed=3)
+    b = build_plan(keys, 4, seed=3)
+    assert a.digest() == b.digest()
+    assert a.parent == b.parent and a.children == b.children
+    # a different seed rotates which workers get elected (same shape)
+    c = build_plan(keys, 4, seed=4)
+    assert c.digest() != a.digest()
+    assert c.n_edges == a.n_edges and c.depth == a.depth
+
+
+def test_plan_digest_byte_identical_across_processes():
+    """The cross-process identity contract: a second python process with
+    the same membership computes the same tree (no hash(), no RNG state,
+    no wall clock anywhere in the builder)."""
+    keys = _keys(11, host="10.1.2.3") + _keys(6, host="10.4.5.6")
+    here = build_plan(keys, 3, seed=9).digest()
+    prog = (
+        "from distributed_sgd_tpu.aggtree import build_plan\n"
+        "keys = [('10.1.2.3', 7000 + i) for i in range(11)]\n"
+        "keys += [('10.4.5.6', 7000 + i) for i in range(6)]\n"
+        "print(build_plan(keys, 3, seed=9).digest())\n"
+    )
+    out = subprocess.run([sys.executable, "-c", prog], text=True,
+                         capture_output=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.strip() == here
+
+
+def test_plan_groups_by_host_locality():
+    """One host's workers stay contiguous under their own elected
+    aggregator: no cross-host edge below a host's subtree root."""
+    keys = [("rack-a", 1), ("rack-b", 1), ("rack-a", 2), ("rack-b", 2),
+            ("rack-a", 3), ("rack-b", 3), ("rack-a", 4), ("rack-b", 4)]
+    plan = build_plan(keys, 2, seed=0)
+    for k, kids in plan.children.items():
+        for c in kids:
+            # an interior edge never crosses hosts unless the PARENT is
+            # a subtree root gluing whole host groups together
+            if plan.parent[k] is not None:
+                assert c[0] == k[0], f"cross-host edge {k} -> {c}"
+
+
+def test_build_plan_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        build_plan(_keys(4), 1)
+    with pytest.raises(ValueError):
+        build_plan([("h", 1), ("h", 1)], 2)
+
+
+# -- 2. wire compatibility: knobs-off is byte-identical ---------------------
+
+
+def test_empty_agg_fields_add_zero_wire_bytes():
+    """Proto3 default scalars/empty repeateds serialize to NOTHING: a
+    request/update that never touches the agg fields is byte-identical
+    to the pre-aggtree wire (the knobs-off identity witness)."""
+    base = pb.GradientRequest(samples=[1, 2, 3], fit_token=7)
+    touched = pb.GradientRequest(samples=[1, 2, 3], fit_token=7,
+                                 agg_parent="", agg_round=0, agg_wait_ms=0)
+    assert base.SerializeToString() == touched.SerializeToString()
+    g = codec.encode_grad(np.ones(8, dtype=np.float32))
+    g2 = pb.GradUpdate()
+    g2.CopyFrom(g)
+    g2.agg_flat = False
+    g2.agg_partial = False
+    del g2.agg_contributors[:]
+    assert g.SerializeToString() == g2.SerializeToString()
+
+
+def test_armless_forwarded_ack_decodes_as_zero():
+    """A child that pushed its gradient up the tree acks the master with
+    an armless GradUpdate(agg_forwarded): it must contribute NOTHING to
+    the accumulator — not an empty vector, not a shape error."""
+    ack = pb.GradUpdate(agg_forwarded=True)
+    assert codec.parse_grad(ack) == ("zero",)
+    out = np.full(16, 3.0, dtype=np.float32)
+    codec.decode_grad_into(ack, out)
+    assert np.array_equal(out, np.full(16, 3.0, dtype=np.float32))
+
+
+def test_agg_grad_roundtrip():
+    g = codec.encode_grad(np.arange(6, dtype=np.float32))
+    req = pb.AggGrad(fit_token=42, round=3, origin="h:1")
+    req.update.CopyFrom(g)
+    back = pb.AggGrad.FromString(req.SerializeToString())
+    assert back.fit_token == 42 and back.round == 3 and back.origin == "h:1"
+    assert np.array_equal(codec.decode_grad(back.update),
+                          np.arange(6, dtype=np.float32))
+
+
+# -- 3. the reducer buffer contract -----------------------------------------
+
+
+class _FakeWorker:
+    def __init__(self):
+        self.metrics = mm.Metrics()
+        self.node_label = "h:0"
+
+
+def test_reducer_collect_consumes_and_orders():
+    red = Reducer(_FakeWorker())
+    for origin in ("c:2", "c:1"):  # arrival order != canonical order
+        red.offer(1, 5, origin, codec.encode_grad(np.ones(4, np.float32)))
+    got = red.collect(1, 5, ["c:1", "c:2"], wait_s=1.0)
+    assert list(got) == ["c:1", "c:2"]
+    # consumed: a second collect for the same round sees nothing
+    assert red.collect(1, 5, ["c:1", "c:2"], wait_s=0.0) == {}
+
+
+def test_reducer_partial_on_timeout():
+    red = Reducer(_FakeWorker())
+    red.offer(1, 1, "c:1", codec.encode_grad(np.ones(4, np.float32)))
+    t0 = time.monotonic()
+    got = red.collect(1, 1, ["c:1", "c:2"], wait_s=0.3)
+    assert list(got) == ["c:1"]
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_reducer_bounds_pending_rounds():
+    red = Reducer(_FakeWorker())
+    for r in range(MAX_PENDING_ROUNDS + 4):
+        red.offer(1, r, "c:1", pb.GradUpdate())
+    assert len(red._rounds) == MAX_PENDING_ROUNDS
+    # the OLDEST rounds aged out
+    assert (1, 0) not in red._rounds and (1, 3) not in red._rounds
+    assert (1, MAX_PENDING_ROUNDS + 3) in red._rounds
+
+
+def test_reducer_reduce_is_canonical_order_sum():
+    red = Reducer(_FakeWorker())
+    own = np.array([1.0, 2.0], dtype=np.float32)
+    ups = [codec.encode_grad(np.array([x, x], dtype=np.float32))
+           for x in (3.0, 5.0)]
+    out = red.reduce(own, ups)
+    assert np.array_equal(out, np.array([9.0, 10.0], dtype=np.float32))
+    assert np.array_equal(red.reduce(own, []), own)
+
+
+def test_wait_budget_from_request_stamp():
+    assert wait_budget_s(pb.GradientRequest(agg_wait_ms=250)) == 0.25
+    assert wait_budget_s(pb.GradientRequest()) == 5.0
+
+
+# -- 4. end-to-end: tree fit = flat fit, and the tree is deterministic ------
+
+
+def test_tree_fit_matches_flat_and_tree_runs_are_identical(data, model_fn):
+    """N=8 fanout:2 smoke (the non-slow tier-1 gate): the tree run lands
+    on the flat run's loss (same gradients, f32 reassociation only) and
+    two tree runs are BYTE-identical — the canonical-order jitted chain
+    leaves no nondeterminism."""
+    train, test = data
+    g = mm.global_metrics()
+    with DevCluster(model_fn(), train, test, n_workers=8) as c:
+        flat = _fit(c)
+        kids0 = g.counter(mm.AGG_CHILDREN).value
+        tree1 = _fit(c, agg_tree="fanout:2")
+        tree2 = _fit(c, agg_tree="fanout:2")
+        # elected aggregators actually reduced children in-tree
+        assert g.counter(mm.AGG_CHILDREN).value > kids0
+        assert g.gauge(mm.TREE_DEPTH).value >= 2
+        assert g.gauge(mm.TREE_EDGES).value > 0
+    assert np.array_equal(tree1.state.weights, tree2.state.weights), (
+        "tree runs over identical membership/plan must be byte-identical")
+    assert tree1.losses == tree2.losses
+    # vs flat: same mean gradient up to f32 reassociation of subtree sums
+    np.testing.assert_allclose(tree1.state.weights, flat.state.weights,
+                               rtol=0, atol=1e-5)
+    assert abs(tree1.losses[-1] - flat.losses[-1]) <= 1e-4 + 0.02 * abs(
+        flat.losses[-1])
+
+
+def test_churn_rebuilds_tree_within_one_round(data, model_fn):
+    """A graceful leave mid-fit hits the SAME hook as the resplit: the
+    next window rebuilds the plan against the new membership and the fit
+    completes — no stop-the-world, no eviction of live workers."""
+    train, test = data
+    g = mm.global_metrics()
+    rebuilds0 = g.counter(mm.TREE_REBUILDS).value
+    with DevCluster(model_fn(), train, test, n_workers=5) as c:
+        first_round = threading.Event()
+        w0 = c.workers[0]
+        orig = w0.compute_gradient
+
+        def traced(w, ids):
+            first_round.set()
+            return orig(w, ids)
+
+        w0.compute_gradient = traced
+        box = {}
+
+        def run():
+            try:
+                box["res"] = _fit(c, max_epochs=4, agg_tree="fanout:2")
+            except Exception as e:  # noqa: BLE001 - surfaced to the test
+                box["exc"] = e
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert first_round.wait(60), "fit never reached a worker"
+        # leave a LEAF (seed 0, one host: worker 4 is a leaf under 3) —
+        # the rebuild fires on membership change whatever the role
+        c.leave_worker(4)
+        t.join(timeout=240)
+        assert not t.is_alive(), "tree fit hung across churn"
+        assert "exc" not in box, f"tree fit raised: {box.get('exc')}"
+        assert box["res"].epochs_run == 4
+        # only the leaver left membership; the 4 live workers survived
+        assert len(c.master._workers) == 4
+        for w in c.workers:
+            assert (w.host, w.port) in c.master._workers
+    assert g.counter(mm.TREE_REBUILDS).value > rebuilds0
+
+
+def test_dead_parent_degrades_to_flat_for_exactly_that_round(
+        data, model_fn, monkeypatch):
+    """A failed upstream push must cost the TREE, not the round: the
+    child replies its subtree sum direct to the master tagged agg_flat,
+    the master counts one flat fallback, nobody is evicted, and the next
+    round rides the tree again."""
+    from distributed_sgd_tpu.aggtree import reduce as agg_reduce
+
+    train, test = data
+    g = mm.global_metrics()
+    flat0 = g.counter(mm.TREE_FLAT_FALLBACK).value
+    fails = {"left": 1}
+    orig_push = agg_reduce.Reducer.push_up
+
+    def flaky_push(self, parent, fit_token, agg_round, msg):
+        if fails["left"] > 0:
+            fails["left"] -= 1
+            return False  # parent unreachable for this one push
+        return orig_push(self, parent, fit_token, agg_round, msg)
+
+    monkeypatch.setattr(agg_reduce.Reducer, "push_up", flaky_push)
+    with DevCluster(model_fn(), train, test, n_workers=5) as c:
+        res = _fit(c, agg_tree="fanout:2")
+        assert res.epochs_run == 2
+        assert len(c.master._workers) == 5, (
+            "flat fallback must not evict anyone")
+    assert fails["left"] == 0, "no push was ever attempted"
+    # exactly the one failed push degraded; later rounds rode the tree
+    assert g.counter(mm.TREE_FLAT_FALLBACK).value == flat0 + 1
+    assert g.counter(mm.AGG_BYTES_UP).value > 0
+
+
+def test_knobs_off_builds_no_plan_and_registers_no_instruments(
+        data, model_fn, monkeypatch):
+    """DSGD_AGG_TREE off = the subsystem does not exist: build_plan is
+    never called, no worker constructs a Reducer, and no NEW tree/agg
+    instrument lands in any registry."""
+    import distributed_sgd_tpu.aggtree as aggtree
+
+    def boom(*a, **kw):
+        raise AssertionError("build_plan called with the knob off")
+
+    monkeypatch.setattr(aggtree, "build_plan", boom)
+    train, test = data
+    g = mm.global_metrics()
+    before = {c.name for c in g.counters()} | {x.name for x in g.gauges()}
+    with DevCluster(model_fn(), train, test, n_workers=2) as c:
+        res = _fit(c, max_epochs=1)
+        assert res.epochs_run == 1
+        for w in c.workers:
+            assert w._agg is None, "knobs-off worker built a Reducer"
+    after = {c.name for c in g.counters()} | {x.name for x in g.gauges()}
+    fresh = after - before
+    assert not [n for n in fresh
+                if n.startswith("master.tree.") or n.startswith("slave.agg.")]
+
+
+# -- 5. satellite guards -----------------------------------------------------
+
+
+def test_no_flight_litter_tracked_at_repo_root():
+    """Flight-recorder dumps (flight-*.json) are run artifacts: they are
+    gitignored and must never be committed at the repo root again."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    if not (root / ".git").exists():
+        pytest.skip("not a git checkout")
+    out = subprocess.run(["git", "ls-files", "flight-*.json"], cwd=root,
+                         text=True, capture_output=True, timeout=60)
+    if out.returncode != 0:
+        pytest.skip(f"git unavailable: {out.stderr.strip()}")
+    assert out.stdout.strip() == "", (
+        f"flight litter tracked at repo root: {out.stdout.split()}")
+
+
+def test_hedge_scratch_leaves_donor_resident_untouched(data, model_fn):
+    """Satellite (a): a hedge for FOREIGN rows on a host-local donor is
+    served from a bounded scratch read (RowReader window), never by
+    sliding the donor's resident slice — offset/extent/reload counters
+    stay exactly as they were, and the gradient matches the owner's."""
+    train, test = data
+    g = mm.global_metrics()
+    with DevCluster(model_fn(), train, test, n_workers=4,
+                    host_local=True) as c:
+        donor, owner = c.workers[0], c.workers[3]
+        res0 = donor._resident
+        reloads0 = g.counter(mm.DATA_RELOADS).value
+        scratch0 = g.counter(mm.HEDGE_SCRATCH).value
+        w = np.zeros(train.n_features, dtype=np.float32)
+        # worker 3's slice is the last quarter of the TRAIN split
+        lo = 3 * (len(train) // 4)
+        foreign = np.arange(lo + 10, lo + 22, dtype=np.int64)
+        got = donor.compute_gradient_hedged(w, foreign)
+        want = owner.compute_gradient(w, foreign)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+        assert g.counter(mm.HEDGE_SCRATCH).value == scratch0 + 1
+        assert g.counter(mm.DATA_RELOADS).value == reloads0, (
+            "scratch hedge slid the resident window")
+        res1 = donor._resident
+        assert res1.offset == res0.offset and res1.n == res0.n
+        # ids inside the donor's own slice take the normal path: no
+        # scratch read, same resident arrays
+        own_ids = np.arange(10, 20, dtype=np.int64)
+        a = donor.compute_gradient_hedged(w, own_ids)
+        b = donor.compute_gradient(w, own_ids)
+        assert np.array_equal(a, b)
+        assert g.counter(mm.HEDGE_SCRATCH).value == scratch0 + 1
