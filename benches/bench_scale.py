@@ -56,6 +56,28 @@ rounds (flat fallback), the master evicts the corpse and rebuilds the
 plan on the same hook as the resplit, zero LIVE workers are evicted,
 and the fit completes every epoch.
 
+Shard-sweep rows (ISSUE 18, docs/MASTER_SHARDING.md): on the flat
+knobs-off master, `DSGD_MASTER_SHARDS=M` range-partitions the weight
+vector across M shard lanes so each lane broadcasts and fans in only
+its dim/M slice.  Per (M, N) in {1,2,4} x the shard sweep the bench
+asserts sharded-vs-flat weights BIT-identical (range-disjoint SGD
+commutes — drift 0.0, not allclose) and records
+`m{M}_n{N}_proc_bytes`, the max-over-lanes broadcast+fan-in wire bytes
+one shard process carries (gated DOWN through the bytes class), plus
+`m{M}_n{N}_bytes_reduction` vs the flat single-process total (gated UP
+through the bytes_reduction class).  The hard gate is >= 1.5x
+bytes-per-process reduction at M=4/N=32 — a BYTES gate, not wall-clock:
+on a one-box loopback wire the win is capacity (what one master process
+must push/decode per round), which is exactly what bytes measure and
+scheduler noise cannot fake.  The shard chaos row HARD-KILLS one shard
+lane mid-fit: exactly one flat single-master fallback round absorbs the
+loss, the plan rebuilds at M-1 on the advance hook, ZERO live workers
+are evicted, the fit completes every epoch, and the final weights still
+match the flat run bit for bit.  The shard rows are recorded as their
+OWN history series (`scale_shard_{smoke,full}`, split_shard_series):
+they are deterministic bytes, and welding them to the wall-clock series
+would let a slow box day block recording them.
+
 Run: ``python bench.py --scale [--smoke]``.  One JSON line on stdout;
 diagnostics on stderr.  The chaos-weather endurance sibling is
 ``python bench.py --soak`` (benches/bench_soak.py).
@@ -67,6 +89,7 @@ import contextlib
 import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -86,16 +109,25 @@ TREE_GATE_X = 2.0
 # same shape as bench_chaos/bench_soak's in-run parity bound
 PARITY_REL = 1.02
 PARITY_ABS = 0.02
+# feature-sharded master plane (ISSUE 18): shard counts swept per N, and
+# the >= 1.5x bytes-per-process reduction bar at M=4/N=32 (bytes, not
+# wall-clock — see module docstring)
+SHARD_M = (1, 2, 4)
+SHARD_GATE_M = 4
+SHARD_GATE_N = 32
+SHARD_GATE_X = 1.5
 
 SMOKE = dict(
     n=1280, n_features=512, nnz=8, global_batch=128, epochs=5, lr=0.5,
     sweep=(4, 32), tree=(32,), reps=4,
     chaos_n=12, chaos_epochs=3,
+    shard_n=(8, 32), shard_epochs=2,
 )
 FULL = dict(
     n=1280, n_features=512, nnz=8, global_batch=128, epochs=8, lr=0.5,
     sweep=(4, 16, 32, 64), tree=(16, 32, 64, 128), reps=3,
     chaos_n=12, chaos_epochs=4,
+    shard_n=(8, 32), shard_epochs=4,
 )
 
 
@@ -340,6 +372,135 @@ def _chaos_row(train, test, make, cfg: dict) -> dict:
             "chaos_final_loss_info": round(float(res.losses[-1]), 5)}
 
 
+def _shard_point(train, test, make, cfg: dict, n_workers: int) -> dict:
+    """One shard-sweep N: flat baseline then M in SHARD_M on the same
+    warm cluster — bit-identity asserted, per-process wire bytes
+    recorded (max over lanes vs the flat single-process total)."""
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.utils import metrics as mm
+    import jax
+
+    batch = max(1, cfg["global_batch"] // n_workers)
+    g = mm.global_metrics()
+    rows = {}
+    with DevCluster(make(), train, test, n_workers=n_workers, seed=0,
+                    devices=[jax.devices()[0]]) as c:
+        zeros = np.zeros(train.n_features, dtype=np.float32)
+        warm_ids = np.arange(batch, dtype=np.int64)
+        for w in c.workers:
+            w.compute_gradient(zeros, warm_ids)
+        c.master.local_loss(zeros)
+        b0 = g.counter(mm.SYNC_BCAST_BYTES).value
+        r0 = g.counter(mm.SYNC_GRAD_BYTES).value
+        flat = c.master.fit_sync(
+            max_epochs=cfg["shard_epochs"], batch_size=batch,
+            learning_rate=cfg["lr"], grad_timeout_s=30.0)
+        # the flat master is ONE process: its per-process wire cost is
+        # the whole broadcast + fan-in ledger
+        flat_bytes = (g.counter(mm.SYNC_BCAST_BYTES).value - b0
+                      + g.counter(mm.SYNC_GRAD_BYTES).value - r0)
+        w_flat = np.asarray(flat.state.weights)
+        rows[f"n{n_workers}_flat_proc_bytes"] = int(flat_bytes)
+        log(f"  N={n_workers:3d} flat : {flat_bytes:9d} bytes/process")
+        for m in SHARD_M:
+            res = c.master.fit_sync(
+                max_epochs=cfg["shard_epochs"], batch_size=batch,
+                learning_rate=cfg["lr"], grad_timeout_s=30.0,
+                master_shards=m)
+            assert np.array_equal(np.asarray(res.state.weights), w_flat), (
+                f"M={m} sharded weights drifted from the flat master at "
+                f"N={n_workers} — range-disjoint SGD must be bit-exact")
+            ledger = c.master._last_shard_bytes
+            assert ledger and len(ledger) == min(m, train.n_features), (
+                f"shard ledger missing at M={m}, N={n_workers}")
+            per_proc = max(b + gr for _, b, gr in ledger)
+            reduction = flat_bytes / per_proc
+            rows[f"m{m}_n{n_workers}_proc_bytes"] = int(per_proc)
+            rows[f"m{m}_n{n_workers}_bytes_reduction"] = round(reduction, 3)
+            log(f"  N={n_workers:3d} M={m}  : {per_proc:9d} bytes/process "
+                f"({reduction:.2f}x reduction, drift 0.0)")
+    return rows
+
+
+def _shard_chaos_row(train, test, make, cfg: dict) -> dict:
+    """Kill one shard lane mid-fit: the next window runs ONE flat
+    single-master fallback round, the plan rebuilds at M-1 on the
+    advance hook, zero workers are evicted, the fit completes every
+    epoch, and the weights still match the flat run bit for bit."""
+    import threading
+
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.utils import metrics as mm
+    import jax
+
+    n = cfg["chaos_n"]
+    m = SHARD_GATE_M
+    batch = max(1, cfg["global_batch"] // n)
+    g = mm.global_metrics()
+    fb0 = g.counter(mm.SHARD_FALLBACK_ROUNDS).value
+    rb0 = g.counter(mm.SHARD_REBUILDS).value
+    with DevCluster(make(), train, test, n_workers=n, seed=0,
+                    devices=[jax.devices()[0]]) as c:
+        zeros = np.zeros(train.n_features, dtype=np.float32)
+        for w in c.workers:
+            w.compute_gradient(zeros, np.arange(batch, dtype=np.int64))
+        flat = c.master.fit_sync(
+            max_epochs=cfg["chaos_epochs"], batch_size=batch,
+            learning_rate=cfg["lr"], grad_timeout_s=30.0)
+        box = {}
+
+        def run():
+            try:
+                box["res"] = c.master.fit_sync(
+                    max_epochs=cfg["chaos_epochs"], batch_size=batch,
+                    learning_rate=cfg["lr"], grad_timeout_s=30.0,
+                    master_shards=m)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                box["exc"] = e
+
+        r0 = g.counter(mm.SYNC_ROUNDS).value
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        t_end = time.monotonic() + 60
+        while (g.counter(mm.SYNC_ROUNDS).value < r0 + 2
+               and time.monotonic() < t_end and t.is_alive()):
+            time.sleep(0.02)
+        assert t.is_alive(), "sharded chaos fit finished before the kill"
+        c.master.kill_shard(1)
+        log(f"  shard chaos: killed shard lane 1 mid-fit (M={m}, N={n})")
+        t.join(timeout=300)
+        assert not t.is_alive(), "sharded fit hung after shard kill"
+        assert "exc" not in box, f"sharded chaos fit raised: {box['exc']}"
+        res = box["res"]
+        assert res.epochs_run == cfg["chaos_epochs"]
+        # zero evictions: a master-shard death is a MASTER-side failure
+        # and must never cost a worker its membership
+        lost = [(w.host, w.port) for w in c.workers
+                if (w.host, w.port) not in c.master._workers]
+        assert not lost, f"live workers evicted under shard chaos: {lost}"
+        assert np.array_equal(np.asarray(res.state.weights),
+                              np.asarray(flat.state.weights)), (
+            "shard-kill chaos run drifted from the flat master")
+    fallbacks = g.counter(mm.SHARD_FALLBACK_ROUNDS).value - fb0
+    rebuilds = g.counter(mm.SHARD_REBUILDS).value - rb0
+    # the kill dumps the flight ring at cwd by design — same litter
+    # discipline as the tree chaos row above
+    for litter in glob.glob(f"flight-*-{os.getpid()}-shard-kill.json"):
+        with contextlib.suppress(OSError):
+            os.remove(litter)
+    assert fallbacks == 1, (
+        f"a shard kill must cost EXACTLY one flat fallback round, "
+        f"got {fallbacks}")
+    assert rebuilds == 1, (
+        f"the kill must rebuild the shard plan exactly once, got {rebuilds}")
+    log(f"  shard chaos: {fallbacks} flat fallback round, {rebuilds} "
+        f"rebuild, 0 evictions, {res.epochs_run} epochs, drift 0.0")
+    return {"shard_chaos_fallback_rounds": int(fallbacks),
+            "shard_chaos_rebuilds": int(rebuilds),
+            "shard_chaos_live_evictions": 0,
+            "shard_chaos_final_loss_info": round(float(res.losses[-1]), 5)}
+
+
 def run_bench(smoke: bool = False) -> dict:
     cfg = SMOKE if smoke else FULL
     label = "smoke" if smoke else "full"
@@ -348,7 +509,8 @@ def run_bench(smoke: bool = False) -> dict:
     log(f"scale bench ({label}): n={cfg['n']} dim={cfg['n_features']} "
         f"global_batch={cfg['global_batch']} epochs={cfg['epochs']} "
         f"sweep={tuple(all_ns)} tree={cfg['tree']} lanes={LANES} "
-        f"pool={POOL} fanout={TREE_FANOUT}")
+        f"pool={POOL} fanout={TREE_FANOUT} shards={SHARD_M} "
+        f"x N={cfg['shard_n']}")
     train, test, make = _build(cfg)
     points = []
     for n in all_ns:
@@ -406,6 +568,20 @@ def run_bench(smoke: bool = False) -> dict:
         log("tree gate SKIPPED: single-core host (workers and master "
             "share one CPU, so off-master reduce cannot speed the round)")
     chaos = _chaos_row(train, test, make, cfg)
+    # feature-sharded master plane: bytes-per-process sweep + chaos row
+    shard_rows = {}
+    for n in cfg["shard_n"]:
+        shard_rows.update(_shard_point(train, test, make, cfg, n))
+    shard_gate = shard_rows[
+        f"m{SHARD_GATE_M}_n{SHARD_GATE_N}_bytes_reduction"]
+    log(f"shard gate: {shard_gate:.2f}x bytes-per-process reduction at "
+        f"M={SHARD_GATE_M}/N={SHARD_GATE_N} (bar >= {SHARD_GATE_X}x, "
+        f"drift 0.0 at every M x N)")
+    assert shard_gate >= SHARD_GATE_X, (
+        f"sharded master {shard_gate:.2f}x bytes-per-process reduction at "
+        f"M={SHARD_GATE_M}/N={SHARD_GATE_N} — below the >= {SHARD_GATE_X}x "
+        f"bar over the flat master")
+    shard_chaos = _shard_chaos_row(train, test, make, cfg)
 
     result = {
         "metric": f"scale_{label}",
@@ -422,8 +598,13 @@ def run_bench(smoke: bool = False) -> dict:
         "global_batch": cfg["global_batch"],
         "lanes": LANES,
         "pool": POOL,
+        "shard_gate_m": SHARD_GATE_M,
+        "shard_gate_n": SHARD_GATE_N,
+        "shard_bytes_reduction": round(shard_gate, 3),
     }
     result.update(chaos)
+    result.update(shard_rows)
+    result.update(shard_chaos)
     tree_base = min(tree_ns)
     for p in points:
         n = p["n"]
@@ -447,23 +628,62 @@ def run_bench(smoke: bool = False) -> dict:
     return result
 
 
+# shard-sweep row names: the m{M}_n{N}_* matrix, the flat per-process
+# baselines they divide by, and the shard_* gate/chaos summaries
+_SHARD_ROW = re.compile(r"^(m\d+_n\d+_|n\d+_flat_proc_bytes$|shard_)")
+
+
+def split_shard_series(result: dict) -> tuple:
+    """Partition run_bench's combined rows into (timing series, shard series).
+
+    The shard rows are shape-determined bytes (10% regress class) while
+    the rest of the sweep is wall-clock on a shared box (35% class, and
+    still noisy at that).  Recorded as ONE series, a slow box day blocks
+    recording the deterministic capacity rows — so the shard sweep gets
+    its own `"metric"` series (`scale_shard_{smoke,full}`), gated and
+    appended independently, per regress.py's series-independence rule
+    ("one series' value never pollutes another's median").  The stdout
+    contract is untouched: main() still prints the combined dict.
+    """
+    shard = {k: v for k, v in result.items() if _SHARD_ROW.match(k)}
+    timing = {k: v for k, v in result.items() if k not in shard}
+    if shard:
+        shard = {
+            "metric": result["metric"].replace("scale_", "scale_shard_"),
+            # headline, gated lower-is-better: wire bytes the worst shard
+            # process carries at the gate point (deterministic)
+            "value": shard[f"m{SHARD_GATE_M}_n{SHARD_GATE_N}_proc_bytes"],
+            "unit": "bytes",
+            **shard,
+        }
+    return timing, shard
+
+
 def main(smoke: bool = False) -> None:
     result = run_bench(smoke=smoke)
     try:
         from benches import regress
 
-        regressions, lines = regress.check(result, regress.load_history())
+        history = regress.load_history()
+        timing, shard = split_shard_series(result)
+        regressions = []
+        for series in (timing, shard):
+            if not series:
+                continue
+            regs, lines = regress.check(series, history)
+            regressions += regs
+            log(f"regression gate [{series['metric']}] vs stored history, "
+                f"tolerance {regress.DEFAULT_TOLERANCE:.0%}:")
+            for ln in lines:
+                log(ln)
+            if regs:
+                log(f"FAIL [{series['metric']}]: regressed metrics: "
+                    f"{', '.join(regs)} (series NOT recorded)")
+            else:
+                regress.record(series)
+                log(f"PASS [{series['metric']}]: series appended to "
+                    f"benches/history.json")
         result["regressed"] = regressions
-        log(f"regression gate vs stored history, tolerance "
-            f"{regress.DEFAULT_TOLERANCE:.0%}:")
-        for ln in lines:
-            log(ln)
-        if regressions:
-            log(f"FAIL: regressed metrics: {', '.join(regressions)} "
-                f"(run NOT recorded)")
-        else:
-            regress.record(result)
-            log("PASS: run appended to benches/history.json")
     except Exception as e:  # noqa: BLE001 - gating must not break the bench
         log(f"regression gate skipped: {e}")
         result["regressed"] = None

@@ -97,10 +97,18 @@ DEFAULT_TOLERANCE = 0.35  # shared-chip variance headroom (TIMING metrics)
 #   band fails a flywheel that roughly doubles its recovery (a detector
 #   or warm-start break) without false-alarming on cadence jitter the
 #   bench's own hard round-budget assert already bounds;
+# - bytes reduction (`*_bytes_reduction`, the bench_scale shard sweep):
+#   HIGHER is better — the flat master's per-process wire total over the
+#   worst shard lane's, i.e. how much broadcast+fan-in capacity
+#   DSGD_MASTER_SHARDS takes off one master process.  Wire traffic is
+#   shape-determined like the `_bytes` rows it is built from, so the
+#   same 10% band applies: a silently re-inflated slice wire fails the
+#   gate without timing noise ever touching it;
 # - everything else (seconds, rates, `value`): the 35% shared-chip knob.
 CLASS_TOLERANCES = (
     (("_loss", "_acc"), 0.02),
     (("_bytes",), 0.10),
+    (("_bytes_reduction",), 0.10),
     (("_p50_s", "_p99_s"), 0.50),
     (("_spinup_s",), 0.50),
     (("_rounds_per_s",), 0.35),
@@ -143,9 +151,11 @@ def direction(name: str) -> Optional[str]:
         return None
     # rate suffixes first: "*_per_s" would otherwise match the "_s"
     # lower-is-better check and gate throughput backwards; scaling
-    # efficiency (`*_scale_eff`, bench_scale.py) is a higher-is-better
-    # throughput ratio with no timing-shaped suffix to collide with
-    if name.endswith(("_per_s", "_acc", "_scale_eff")):
+    # efficiency (`*_scale_eff`, bench_scale.py) and bytes reduction
+    # (`*_bytes_reduction`, the shard sweep) are higher-is-better ratios
+    # — the latter checked BEFORE the `_bytes` lower-is-better rule so a
+    # bigger reduction can never be gated as re-inflated wire
+    if name.endswith(("_per_s", "_acc", "_scale_eff", "_bytes_reduction")):
         return "up"
     # wire-traffic series (benches/bench_rpc_sync.py, bench_comms.py):
     # bytes gate DOWN so a PR that silently re-inflates the broadcast or

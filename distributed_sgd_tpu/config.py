@@ -205,6 +205,15 @@ class Config:
     # O(F) subtree sums instead of O(N) replies.  "" (default): flat
     # fan-in — no plan built, no reducer constructed, wire byte-identical.
     agg_tree: str = ""
+    # feature-sharded master plane (shardedps/, docs/MASTER_SHARDING.md;
+    # engine=rpc sync fits only): M >= 1 range-partitions the weight
+    # vector across M master shard lanes — per-shard broadcast and
+    # fan-in, global step bit-identical to the flat plane.  Composes with
+    # delta_broadcast and agg_tree (one shard-colored tree per lane);
+    # incompatible with stream / quorum / local_steps>1 / fanin_lanes /
+    # stage_pool / compress (validated below).  0 (default): no shard
+    # plan built, no shard instrument registered, wire byte-identical.
+    master_shards: int = 0
     # tensor parallelism: shard the blocked weight rows over F feature
     # shards (parallel/feature_sharded.py; dev-mode sync scenario only —
     # needs workers x F devices).  1 = the 1-D DP engines (default)
@@ -383,6 +392,22 @@ class Config:
             from distributed_sgd_tpu.aggtree import parse_agg_tree
 
             parse_agg_tree(self.agg_tree)
+        # shard-count grammar owned by shardedps.plan; the composition
+        # matrix (docs/MASTER_SHARDING.md) is enforced at construction so
+        # an incompatible pair fails here, not windows into a fit
+        from distributed_sgd_tpu.shardedps import parse_master_shards
+
+        if parse_master_shards(self.master_shards):
+            for bad, knob in ((self.stream, "DSGD_STREAM"),
+                              (self.quorum is not None, "DSGD_QUORUM"),
+                              (self.local_steps > 1, "DSGD_LOCAL_STEPS"),
+                              (self.fanin_lanes > 0, "DSGD_FANIN_LANES"),
+                              (self.stage_pool > 0, "DSGD_STAGE_POOL"),
+                              (self.compress != "none", "DSGD_COMPRESS")):
+                if bad:
+                    raise ValueError(
+                        f"DSGD_MASTER_SHARDS does not compose with {knob} "
+                        f"(docs/MASTER_SHARDING.md composition table)")
         # fail topology typos at construction; grammar owned by
         # parallel/topology.parse_topology
         from distributed_sgd_tpu.parallel.topology import parse_topology
@@ -663,6 +688,7 @@ class Config:
             fanin_lanes=_env("DSGD_FANIN_LANES", cls.fanin_lanes, int),
             stage_pool=_env("DSGD_STAGE_POOL", cls.stage_pool, int),
             agg_tree=_env("DSGD_AGG_TREE", cls.agg_tree, str),
+            master_shards=_env("DSGD_MASTER_SHARDS", cls.master_shards, int),
             feature_shards=_env("DSGD_FEATURE_SHARDS", cls.feature_shards, int),
             host_devices=_env("DSGD_HOST_DEVICES", cls.host_devices, int),
             compile_cache=_env("DSGD_COMPILE_CACHE", None, str),

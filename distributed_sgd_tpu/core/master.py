@@ -353,13 +353,34 @@ class _LatencyEwma:
         return max(self.FLOOR_S, self.SLACK * ests[max(1, quorum) - 1])
 
 
+def _reply_weight(reply) -> int:
+    """Quorum mass of one barrier reply (docs/AGGREGATION.md §Quorum).
+
+    Under DSGD_AGG_TREE the master's fan-in mixes three reply shapes: a
+    subtree sum carries its exact contributor set (weight = |set|), an
+    armless forwarded ack carries NOTHING — its gradient went up-tree —
+    so it must not satisfy the quorum count blindly (weight 0), and a
+    flat reply (a hedge, or a worker outside the plan) carries exactly
+    one worker's gradient (weight 1).  Flat fits and Forward replies
+    have neither field and weigh 1, so knobs-off counting is unchanged.
+    """
+    if getattr(reply, "agg_contributors", None):
+        return len(reply.agg_contributors)
+    if getattr(reply, "agg_forwarded", False):
+        return 0
+    return 1
+
+
 def _await_quorum(futs, quorum: int, soft_deadline: float,
                   bytes_counter=None, latency: Optional[_LatencyEwma] = None):
     """Quorum barrier over [(key, future-or-None)] (docs/FAULT_TOLERANCE.md).
 
     Waits until every future settles, or until `soft_deadline` (absolute
-    time.monotonic) passes with at least `quorum` successful replies in
-    hand.  Returns (ok, failed, pending): ok/failed as _await_futures,
+    time.monotonic) passes with at least `quorum` worth of successful
+    reply WEIGHT in hand — weight per _reply_weight, so a subtree sum
+    counts its whole contributor set and a forwarded ack counts nothing
+    (plain replies weigh 1, keeping the knobs-off count unchanged).
+    Returns (ok, failed, pending): ok/failed as _await_futures,
     pending = [(key, future)] still in flight — the caller decides
     whether to hedge their slices, keep waiting, or discard them (late
     settles are idempotent: nobody reads an abandoned future).  Reply
@@ -373,6 +394,7 @@ def _await_quorum(futs, quorum: int, soft_deadline: float,
 
     t_sent = time.monotonic()
     ok, failed, pending = [], [], []
+    ok_weight = 0
     for key, fut in futs:
         if fut is None:
             failed.append((key, ValueError("channel closed")))
@@ -392,6 +414,7 @@ def _await_quorum(futs, quorum: int, soft_deadline: float,
                 if latency is not None:
                     latency.record(key, time.monotonic() - t_sent)
                 ok.append((key, reply))
+                ok_weight += _reply_weight(reply)
             except grpc.RpcError as e:
                 failed.append((key, e.code()))
         pending = still
@@ -399,7 +422,7 @@ def _await_quorum(futs, quorum: int, soft_deadline: float,
             break
         now = time.monotonic()
         remaining = soft_deadline - now
-        if remaining <= 0 and len(ok) >= quorum:
+        if remaining <= 0 and ok_weight >= quorum:
             break
         with cv:
             # past the soft deadline but below quorum: keep waiting (the
@@ -593,8 +616,11 @@ class _BroadcastState:
         self.version = 1 if self.versioned else 0
         self._worker_ver: Dict[Tuple[str, int], int] = {}
         self._w_prev: Optional[np.ndarray] = None
-        self._full_msg = None     # encoded lazily, once per version
-        self._delta_msg = None    # pb.WeightDelta, False = dense fallback
+        # the version's wire forms (full tensor / sparse delta), each
+        # encoded lazily at most once — the shared versioned weight-send
+        # plan (rpc/codec.py WeightSendPlan), the SAME path the serving
+        # fleet's checkpoint push and the shard lanes ride
+        self._send_plan: Optional[codec.WeightSendPlan] = None
         # pre-staged round dispatch (DSGD_STREAM, docs/SYNC_PIPELINE.md
         # "Streaming transport"): with staging armed (stage_for), the
         # encoder thread ALSO builds each worker's next request frame —
@@ -631,8 +657,7 @@ class _BroadcastState:
         (encode_ahead) start encoding the new version off-thread."""
         self.version += 1
         self._w_prev = w_old
-        self._full_msg = None
-        self._delta_msg = None
+        self._send_plan = None
         with self._stage_lock:
             self._staged = {}
         if not self.encode_ahead:
@@ -651,15 +676,15 @@ class _BroadcastState:
 
     def _preencode(self, w: np.ndarray) -> None:
         """Encoder-thread body: build the forms `populate` will need —
-        results land in the same lazy slots, `_join_encode` gives the
-        happens-before edge — then stage per-worker request frames when
-        staging is armed (both slots are set by then, so _attach_arm
+        the resolved plan lands in the lazy slot, `_join_encode` gives
+        the happens-before edge — then stage per-worker request frames
+        when staging is armed (the slot is set by then, so _attach_arm
         never joins from the encoder thread itself)."""
-        full = codec.encode_tensor(w)
+        plan = self._new_plan(w)
+        plan.full()
         if self.delta_broadcast:
-            # False ("use the full form") is itself a computed result
-            self._delta_msg = self._compute_delta(w)
-        self._full_msg = full
+            plan.delta()  # "use the full form" is itself a computed result
+        self._send_plan = plan
         if self._stage_keys and self._stage_ctx is not None:
             self._build_staged(w)
 
@@ -759,52 +784,45 @@ class _BroadcastState:
         (dispatch thread, joins the encoder through the lazy slot reads)
         and _build_staged (encoder thread, slots already set)."""
         if not self.delta_broadcast:
-            full = self._full(w)
+            full = self._plan_for(w).full()
             req.weights.CopyFrom(full)
             if self.versioned:
                 req.step_version = self.version
             return "full", full.ByteSize()
         req.step_version = self.version
-        wv = self._worker_ver.get(key)
-        if wv == self.version:
+        plan = self._plan_for(w)
+        arm = plan.choose_arm(self._worker_ver.get(key), self.version)
+        if arm == "cached":
             return "cached", 0
-        if wv is not None and wv == self.version - 1:
-            delta = self._delta(w)
-            if delta is not None:
-                req.delta.CopyFrom(delta)
-                return "delta", delta.ByteSize()
-        full = self._full(w)
+        if arm == "delta":
+            delta = plan.delta()
+            req.delta.CopyFrom(delta)
+            return "delta", delta.ByteSize()
+        full = plan.full()
         req.weights.CopyFrom(full)
         return "full", full.ByteSize()
 
-    def _full(self, w: np.ndarray):
-        # slot first, join only on a miss: a set slot IS the encoder's
-        # finished result (it is assigned last), and checking first lets
-        # the encoder thread itself resolve forms while staging frames
-        # without deadlocking on its own future
-        if self._full_msg is None:
-            self._join_encode()
-        if self._full_msg is None:
-            self._full_msg = codec.encode_tensor(w)
-        return self._full_msg
-
-    def _compute_delta(self, w: np.ndarray):
-        """The sparse WeightDelta vs the previous version, or False when a
-        full tensor is the smaller (or only possible) wire form.  The
-        encode itself is the shared absolute-value delta codec
-        (rpc/codec.py encode_weight_delta) — the same path the serving
-        fleet's checkpoint distribution rides (serving/push.py)."""
-        delta = codec.encode_weight_delta(
-            w, self._w_prev, base_version=self.version - 1,
+    def _new_plan(self, w: np.ndarray) -> "codec.WeightSendPlan":
+        """This version's shared weight-send plan (rpc/codec.py): the
+        delta-vs-full choice and both lazy encodes live in the ONE
+        helper the checkpoint pusher and the shard lanes also walk.
+        Without delta_broadcast the sparse form is disabled outright
+        (w_prev=None), so the plan degrades to a lazy encode_tensor."""
+        return codec.plan_weight_send(
+            w, self._w_prev if self.delta_broadcast else None,
+            base_version=self.version - 1,
             break_even=self.SPARSE_BREAK_EVEN)
-        return False if delta is None else delta
 
-    def _delta(self, w: np.ndarray):
-        if self._delta_msg is None:
+    def _plan_for(self, w: np.ndarray) -> "codec.WeightSendPlan":
+        # slot first, join only on a miss: a set slot IS the encoder's
+        # finished result (assigned last, forms already resolved), and
+        # checking first lets the encoder thread itself resolve forms
+        # while staging frames without deadlocking on its own future
+        if self._send_plan is None:
             self._join_encode()
-        if self._delta_msg is None:
-            self._delta_msg = self._compute_delta(w)
-        return self._delta_msg or None
+        if self._send_plan is None:
+            self._send_plan = self._new_plan(w)
+        return self._send_plan
 
 
 class MasterNode:
@@ -847,6 +865,17 @@ class MasterNode:
         # aggregation-tree plane default (DSGD_AGG_TREE, docs/AGGREGATION.md):
         # "" = flat fan-in; "fanout:F" elects sub-aggregator reduce nodes
         self.agg_tree = ""
+        # feature-sharded master plane default (DSGD_MASTER_SHARDS,
+        # docs/MASTER_SHARDING.md): 0 = the flat single-master wire;
+        # M >= 1 range-partitions the weight vector across M shard lanes
+        self.master_shards = 0
+        # the in-flight fit's shard coordinator (set/cleared by fit_sync);
+        # kill_shard() routes the bench chaos hook through it
+        self._shard_coord = None
+        # last sharded fit's per-lane wire ledger, [(index, bcast_bytes,
+        # grad_bytes)] — the bench's bytes-per-process gate reads it after
+        # the fit returns (the coordinator itself is fit-scoped)
+        self._last_shard_bytes = None
 
         self._workers: Dict[Tuple[str, int], WorkerStub] = {}
         self._channels: Dict[Tuple[str, int], grpc.Channel] = {}
@@ -1593,6 +1622,20 @@ class MasterNode:
         self.log.info("aggregation tree: %r", plan)
         return plan
 
+    def kill_shard(self, index: int) -> None:
+        """Chaos hook (benches/bench_scale.py --scale chaos row): declare
+        master shard `index` of the in-flight sharded fit dead.  The next
+        window degrades to ONE flat single-master round, then the shard
+        plan rebuilds over the survivors — live workers are never evicted
+        for a master-side death (docs/MASTER_SHARDING.md failure
+        matrix).  Raises when no sharded fit is in flight."""
+        coord = self._shard_coord
+        if coord is None:
+            raise RuntimeError(
+                "kill_shard: no sharded fit in flight "
+                "(DSGD_MASTER_SHARDS, docs/MASTER_SHARDING.md)")
+        coord.kill(int(index))
+
     @staticmethod
     def _annotate_tree(req, key, plan, agg_round: int,
                        grad_timeout_s: float) -> None:
@@ -1647,6 +1690,7 @@ class MasterNode:
         fanin_lanes: Optional[int] = None,
         stage_pool: Optional[int] = None,
         agg_tree: Optional[str] = None,
+        master_shards: Optional[int] = None,
     ) -> FitResult:
         """Fault-tolerant sync fit, with an optional pipelined wire path.
 
@@ -1729,11 +1773,31 @@ class MasterNode:
           `self.stage_pool` (0 = draws and builds on the dispatch path,
           byte-identical).
 
+        - `master_shards=M` (DSGD_MASTER_SHARDS, docs/MASTER_SHARDING.md):
+          range-partition the weight vector across M master shard lanes —
+          each lane broadcasts only its contiguous feature slice (through
+          the same delta/codec path), workers rendezvous the M slices,
+          compute ONCE, and reply per-slice, and each lane applies its
+          slice independently; range-disjoint hinge-loss SGD commutes, so
+          the step is bit-identical to the flat plane while broadcast AND
+          fan-in bytes per master process scale down ~1/M.  Composes with
+          delta_broadcast (per-lane versions) and agg_tree (one
+          shard-colored tree per lane); refuses stream / quorum /
+          local_steps>1 / fanin_lanes / stage_pool.  A killed shard
+          (kill_shard) costs ONE flat fallback round, then the plan
+          rebuilds over the survivors.  0/None (default): no coordinator,
+          no shard instrument, wire byte-identical.
+
         Quorum barrier (DSGD_QUORUM, docs/FAULT_TOLERANCE.md; Chen et al.
         2016's N+b backup-replica shape): with `quorum=Q` the window
         barrier returns once all replies land OR once a soft deadline
         (`straggler_soft_s`, or p95-adaptive from each worker's reply
-        latency EWMA when unset) fires with >= Q usable replies in hand.
+        latency EWMA when unset) fires with >= Q worth of CONTRIBUTOR
+        weight in hand — under DSGD_AGG_TREE a subtree sum counts its
+        whole contributor set and a forwarded ack counts zero
+        (_reply_weight), so acks from leaves whose gradients sit inside
+        a straggling aggregator never satisfy the count blindly; flat
+        replies weigh one, keeping the tree-off count unchanged.
         The master then hedges each missing worker's data slice to the
         fastest responders (`hedge=True`), prefers a straggler's own reply
         if it lands during the hedge window, averages over the actual
@@ -1804,11 +1868,44 @@ class MasterNode:
 
             tree_fanout = parse_agg_tree(tree_spec)
         tree_plan = None
+        # feature-sharded master plane (DSGD_MASTER_SHARDS,
+        # docs/MASTER_SHARDING.md): 0/None = the flat single-master wire —
+        # no coordinator, no shard instrument, byte-identical
+        # (tests/test_shardedps.py).  M >= 1 range-partitions every
+        # round's broadcast AND fan-in across M shard lanes; the
+        # restrictions below mirror Config.__post_init__ for embedders
+        # that call fit_sync directly.
+        from distributed_sgd_tpu.shardedps import parse_master_shards
+
+        n_shards = parse_master_shards(
+            self.master_shards if master_shards is None else master_shards)
+        if n_shards:
+            for bad, knob in ((stream, "DSGD_STREAM"),
+                              (quorum is not None, "DSGD_QUORUM"),
+                              (local_steps > 1, "DSGD_LOCAL_STEPS"),
+                              (lanes > 0, "DSGD_FANIN_LANES"),
+                              (stager is not None, "DSGD_STAGE_POOL")):
+                if bad:
+                    raise ValueError(
+                        f"DSGD_MASTER_SHARDS does not compose with {knob} "
+                        f"(docs/MASTER_SHARDING.md composition table)")
         self._require_ready()
         members = self._members()
         keys = [k for k, _ in members]
-        if tree_fanout:
+        if tree_fanout and not n_shards:
             tree_plan = self._build_tree_plan(keys, tree_fanout)
+        shard_coord = None
+        if n_shards:
+            from distributed_sgd_tpu.shardedps.coordinator import (
+                ShardedCoordinator,
+            )
+
+            # with DSGD_AGG_TREE the coordinator builds ONE shard-colored
+            # tree per lane instead of the flat plan above
+            shard_coord = ShardedCoordinator(
+                self, n_shards, self.model.n_features, keys,
+                delta_broadcast, tree_fanout, grad_timeout_s)
+            self._shard_coord = shard_coord
         parts = self._split_parts(split, members)
         max_samples = max(len(p) for p in parts)
         w = (
@@ -2022,7 +2119,7 @@ class MasterNode:
                         members, keys = current, [k for k, _ in current]
                         parts = self._split_parts(split, members)
                         max_samples = max(len(p) for p in parts)
-                        if tree_fanout:
+                        if tree_fanout and shard_coord is None:
                             # the reduce tree is a pure function of the
                             # member list: rebuild it on the SAME hook the
                             # resplit fires, so plan and split always
@@ -2034,6 +2131,12 @@ class MasterNode:
                             flight.record("tree.rebuild",
                                           members=len(keys),
                                           depth=tree_plan.depth)
+                        if shard_coord is not None:
+                            # the shard plan keys on (dim, M), not the
+                            # member list — but the per-lane trees and
+                            # per-lane version claims do: rebuild them on
+                            # the SAME membership hook as the resplit
+                            shard_coord.on_membership(keys)
                         bcast.forget_missing(keys)  # rejoins start from full
                         if use_stream or stager is not None:
                             # re-arm staging for the new membership; departed
@@ -2080,7 +2183,13 @@ class MasterNode:
                         # post-barrier decode: its contributor set (hedge wins,
                         # late originals) is only known once the round closes.
                         decoder = None
-                        if quorum is None:
+                        if shard_coord is not None:
+                            # per-lane slice replies decode in
+                            # ShardedCoordinator.accumulate — an arrival
+                            # decoder would scatter slice-local coordinates
+                            # into the full accumulator at the wrong offsets
+                            pass
+                        elif quorum is None:
                             grad_acc.fill(0.0)
                             decoder = _ArrivalDecoder(grad_acc, lanes=lanes)
                         elif lanes:
@@ -2097,59 +2206,80 @@ class MasterNode:
                         # exact values a never-staged run would
                         staged_ids = (stager.take(rng, keys, epoch, batch)
                                       if stager is not None else None)
-                        for (key, stub), part in zip(members, parts):
-                            ids = (staged_ids[key] if staged_ids is not None
-                                   else _draw_ids(rng, part, batch,
-                                                  window_span))
-                            ids_by_key[key] = ids
-                            frame = None
-                            req = None
-                            if use_stream:
-                                # pre-staged dispatch: the encoder thread
-                                # already built this worker's frame (weight
-                                # arm attached) during the previous barrier —
-                                # dispatch adds the sample draw and writes
-                                frame = bcast.take_staged_frame(key)
-                                if frame is not None:
-                                    req = frame.request
-                            elif stager is not None:
-                                req = bcast.take_staged_request(key)
-                            if req is not None:
-                                req.samples.extend(ids.astype(np.int32))
-                            else:
+                        if shard_coord is not None:
+                            # sharded fan-out: the serial sample draw below
+                            # is the flat loop's exactly (the bit-identity
+                            # contract keys on identical draws); the
+                            # per-lane request build, byte accounting, and
+                            # shard-colored tree stamps are the
+                            # coordinator's (shardedps/coordinator.py)
+                            for (key, stub), part in zip(members, parts):
+                                ids_by_key[key] = _draw_ids(
+                                    rng, part, batch, window_span)
+                            agg_round_seq = shard_coord.dispatch(
+                                members, ids_by_key, w, fit_token,
+                                grad_timeout_s, agg_round_seq)
+                        else:
+                            for (key, stub), part in zip(members, parts):
+                                ids = (staged_ids[key]
+                                       if staged_ids is not None
+                                       else _draw_ids(rng, part, batch,
+                                                      window_span))
+                                ids_by_key[key] = ids
+                                frame = None
+                                req = None
                                 if use_stream:
-                                    frame = pb.Frame()
-                                    req = frame.request
+                                    # pre-staged dispatch: the encoder
+                                    # thread already built this worker's
+                                    # frame (weight arm attached) during
+                                    # the previous barrier — dispatch adds
+                                    # the sample draw and writes
+                                    frame = bcast.take_staged_frame(key)
+                                    if frame is not None:
+                                        req = frame.request
+                                elif stager is not None:
+                                    req = bcast.take_staged_request(key)
+                                if req is not None:
                                     req.samples.extend(ids.astype(np.int32))
-                                    req.fit_token = fit_token
                                 else:
-                                    req = pb.GradientRequest(
-                                        samples=ids.astype(np.int32),
-                                        fit_token=fit_token)
-                                if local_steps > 1:
-                                    req.local_steps = local_steps
-                                    req.batch_size = batch_size
-                                    req.learning_rate = learning_rate
-                                bcast.populate(req, key, w)
-                            if tree_plan is not None and not tree_plan.trivial:
-                                # stamp this worker's tree role (parent /
-                                # children / wait budget) from the plan —
-                                # staged requests and stream frames are
-                                # mutated in place, so the annotation rides
-                                # every transport; a trivial plan (N <= F)
-                                # stamps nothing and the wire stays flat
-                                self._annotate_tree(req, key, tree_plan,
-                                                    agg_round_seq,
-                                                    grad_timeout_s)
-                            rb = ef_rollback.pop(key, None)
-                            if rb is not None:
-                                req.ef_rollback_version = rb
-                                rb_sent[key] = rb  # re-armed if this request fails
-                            fut = self._dispatch_gradient(
-                                key, stub, frame, req, grad_timeout_s, use_stream)
-                            futs.append((key, fut))
-                            if decoder is not None:
-                                decoder.watch(len(futs) - 1, fut)
+                                    if use_stream:
+                                        frame = pb.Frame()
+                                        req = frame.request
+                                        req.samples.extend(
+                                            ids.astype(np.int32))
+                                        req.fit_token = fit_token
+                                    else:
+                                        req = pb.GradientRequest(
+                                            samples=ids.astype(np.int32),
+                                            fit_token=fit_token)
+                                    if local_steps > 1:
+                                        req.local_steps = local_steps
+                                        req.batch_size = batch_size
+                                        req.learning_rate = learning_rate
+                                    bcast.populate(req, key, w)
+                                if (tree_plan is not None
+                                        and not tree_plan.trivial):
+                                    # stamp this worker's tree role
+                                    # (parent / children / wait budget)
+                                    # from the plan — staged requests and
+                                    # stream frames are mutated in place,
+                                    # so the annotation rides every
+                                    # transport; a trivial plan (N <= F)
+                                    # stamps nothing, the wire stays flat
+                                    self._annotate_tree(req, key, tree_plan,
+                                                        agg_round_seq,
+                                                        grad_timeout_s)
+                                rb = ef_rollback.pop(key, None)
+                                if rb is not None:
+                                    req.ef_rollback_version = rb
+                                    # re-armed if this request fails
+                                    rb_sent[key] = rb
+                                fut = self._dispatch_gradient(
+                                    key, stub, frame, req, grad_timeout_s,
+                                    use_stream)
+                                futs.append((key, fut))
+                                if decoder is not None:
+                                    decoder.watch(len(futs) - 1, fut)
                         if (stager is not None
                                 and batch + window_span < max_samples):
                             # overlap window: round t+1's draws run on the
@@ -2158,7 +2288,19 @@ class MasterNode:
                             # the next epoch re-keys the generator)
                             stager.stage(rng, keys, parts, epoch,
                                          batch + window_span, window_span)
-                        if quorum is None:
+                        if shard_coord is not None:
+                            # M x N barrier with per-worker collapse: any
+                            # stale/failed leg degrades the worker exactly
+                            # once (shardedps/coordinator.py collect)
+                            replies = None
+                            good, stale, failed = shard_coord.collect(
+                                grad_bytes)
+                            satisfied = False
+                            if (straggler_soft_s is not None
+                                    and time.perf_counter() - t_batch
+                                    > straggler_soft_s):
+                                stalled.increment()
+                        elif quorum is None:
                             # barrier, with deadlines; receive-side wire accounting
                             # happens per arriving reply inside _await_futures (send-
                             # side comms.* counters live in the workers' compressors),
@@ -2190,7 +2332,9 @@ class MasterNode:
                                 # the fit later recovers (docs/OBSERVABILITY.md)
                                 flight.record(
                                     "quorum.below", epoch=epoch, batch=int(batch),
-                                    version=bcast.version, got=len(good),
+                                    version=bcast.version,
+                                    got=sum(_reply_weight(r)
+                                            for _, r in good),
                                     quorum=min(quorum, len(members)))
                                 # throttled: a minutes-long partition degrades
                                 # EVERY window — keep evidence fresh without
@@ -2247,7 +2391,14 @@ class MasterNode:
                         # contributors (own + hedge replies) and the mean over
                         # |contributors| is the unbiased 1/|ok| scaling of Chen
                         # et al. 2016's backup-worker rule.
-                        if decoder is not None and decoder.defer:
+                        if shard_coord is not None:
+                            # range-disjoint slice fan-in: each lane
+                            # decodes its replies into its OWN view of the
+                            # accumulator and applies its own divisor —
+                            # per coordinate, the flat barrier's exact
+                            # float chain (docs/MASTER_SHARDING.md)
+                            shard_coord.accumulate(grad_acc)
+                        elif decoder is not None and decoder.defer:
                             # quorum + lanes: the contributor set is known
                             # only now — accumulate it in canonical order,
                             # reusing each reply's arrival-callback parse
@@ -2260,7 +2411,9 @@ class MasterNode:
                             grad_acc.fill(0.0)
                             for reply in replies:
                                 codec.decode_grad_into(reply, grad_acc)
-                        if tree_plan is not None and not tree_plan.trivial:
+                        if shard_coord is not None:
+                            pass  # per-lane divisors applied above
+                        elif tree_plan is not None and not tree_plan.trivial:
                             # tree fan-in: each reply is either a subtree
                             # sum tagged with its exact contributor set, a
                             # flat-fallback payload (dead parent), or an
@@ -2336,7 +2489,13 @@ class MasterNode:
                             w_j, opt_state = _opt_step(
                                 jnp.asarray(w), opt_state, jnp.asarray(grad_acc))
                             w = np.asarray(w_j)
-                        bcast.advance(w, w_old)
+                        if shard_coord is not None:
+                            # per-lane versions advance over slices; a
+                            # just-absorbed shard kill rebuilds the plan
+                            # here, before the next window dispatches
+                            shard_coord.advance(w, w_old)
+                        else:
+                            bcast.advance(w, w_old)
                         self.metrics.histogram("master.sync.batch.duration").record(
                             time.perf_counter() - t_batch)
                         batch += window_span
@@ -2406,6 +2565,12 @@ class MasterNode:
                     stopped_early = True
                     break
         finally:
+            # the shard coordinator is fit-scoped: kill_shard must never
+            # reach a coordinator whose fit already returned.  Its wire
+            # ledger outlives it for the bench's bytes-per-process gate.
+            if self._shard_coord is not None:
+                self._last_shard_bytes = self._shard_coord.bytes_by_lane()
+            self._shard_coord = None
             if use_stream:
                 self._close_streams()
             if stager is not None:
@@ -2463,8 +2628,11 @@ class MasterNode:
         Returns (replies, good, stale, failed, satisfied):
 
         - satisfied=True — the round closes NOW with `replies` (>= quorum
-          GradUpdates: workers' own replies plus hedge replies covering
-          straggler slices).  `good` lists the workers whose OWN reply was
+          worth of CONTRIBUTOR weight — _reply_weight: a subtree sum
+          counts its whole contributor set, a forwarded ack counts zero,
+          a flat or hedge reply counts one — so under DSGD_AGG_TREE the
+          quorum measures gradients actually in hand, not acks).  `good`
+          lists the workers whose OWN reply was
           used (liveness + broadcast-version bookkeeping); stragglers'
           discarded windows are marked in `ef_rollback` and their late
           replies are counted (idempotently dropped — nobody reads an
@@ -2505,7 +2673,13 @@ class MasterNode:
         uncovered = ([k for k, _ in pending] + [k for k, _ in failed]
                      + [k for k, _ in stale])
         hedge_futs = []
-        if uncovered and len(good) >= quorum_n and hedge and good:
+        # quorum is counted in CONTRIBUTOR weight, not reply count: under
+        # DSGD_AGG_TREE a subtree sum covers its whole contributor set
+        # while a forwarded ack covers nobody (_reply_weight) — Q acks
+        # from leaves whose gradients are still stuck inside a straggling
+        # aggregator must not close the round
+        good_weight = sum(_reply_weight(r) for _, r in good)
+        if uncovered and good_weight >= quorum_n and hedge and good:
             # hedge each missing slice on the fastest responders: a
             # duplicate Gradient over the straggler's drawn ids, weights
             # populated for the donor (header-only under delta broadcast —
@@ -2576,14 +2750,19 @@ class MasterNode:
         good.sort(key=lambda kr: order[kr[0]])
         replies = [r for _, r in
                    sorted(good + hedge_wins, key=lambda kr: order[kr[0]])]
-        if len(replies) >= quorum_n:
+        # satisfaction in contributor weight (see _reply_weight): the
+        # harvested late originals above may have lifted the weight past
+        # Q even if the soft-deadline snapshot was short, and vice versa
+        # a pile of forwarded acks never lifts it at all
+        reply_weight = sum(_reply_weight(r) for r in replies)
+        if reply_weight >= quorum_n:
             if len(good) < len(ids_by_key):
                 self.metrics.counter(metrics_mod.QUORUM_DEGRADED).increment()
                 missing = [f"{k[0]}:{k[1]}" for k in ids_by_key
                            if k not in own]
                 trace_mod.event(trace_mod.EVENT_QUORUM_DEGRADED,
-                                contributors=len(replies), missing=missing)
-                flight.record("quorum.degraded", contributors=len(replies),
+                                contributors=reply_weight, missing=missing)
+                flight.record("quorum.degraded", contributors=reply_weight,
                               missing=missing)
             for skey, _ in hedge_wins:
                 self.metrics.counter(metrics_mod.QUORUM_HEDGE_WINS).increment()

@@ -298,6 +298,7 @@ class WorkerNode:
         # knobs-off worker registers no aggtree instrument and allocates
         # nothing (tests/test_aggtree.py identity gate)
         self._agg = None
+        self._shard_asm = None
         self._agg_lock = threading.Lock()
 
         # DSGD_PROFILE_DIR on the RPC worker role: a jax.profiler capture
@@ -332,6 +333,23 @@ class WorkerNode:
 
                     self._agg = Reducer(self)
         return self._agg
+
+    def _ensure_shard_assembler(self):
+        """Lazily construct the shard rendezvous (shardedps/assemble.py)
+        on the first shard-tagged Gradient request — the same default-off
+        discipline as the reducer above: a knobs-off worker never calls
+        this and registers no shard instrument (tests/test_shardedps.py
+        identity gate)."""
+        if self._shard_asm is None:
+            with self._agg_lock:
+                if self._shard_asm is None:
+                    from distributed_sgd_tpu.shardedps.assemble import (
+                        ShardAssembler,
+                    )
+
+                    self._shard_asm = ShardAssembler(metrics=self.metrics,
+                                                     log=self.log)
+        return self._shard_asm
 
     # resident-slice views (read-only; the canonical state is the atomic
     # _Resident snapshot — dispatch paths grab the snapshot ONCE and use
@@ -1260,6 +1278,13 @@ class _WorkerServicer:
         # reply it discarded so the EF residual drain rolls back first
         if request.ef_rollback_version:
             self.w.rollback_sync_ef(request.ef_rollback_version)
+        if request.shard_count:
+            # feature-sharded master plane (DSGD_MASTER_SHARDS,
+            # docs/MASTER_SHARDING.md): this request is one lane's leg of
+            # an M-way round — rendezvous the slices, compute once, reply
+            # the range slice.  Flat requests never set shard_count, so
+            # the knobs-off path pays one falsy proto-field read.
+            return self._sharded_update(request)
         w, stale = self.w.resolve_request_weights(request)
         if stale:
             # replica/version mismatch: no gradient to give — the master
@@ -1311,6 +1336,36 @@ class _WorkerServicer:
             # knobs-off dispatch path pays one falsy proto-field read.
             return self._agg_gradient(request, g, k)
         return self._encode_reply(request, g, k)
+
+    def _sharded_update(self, request):
+        """One lane's leg of a sharded round (shardedps/assemble.py):
+        resolve this shard's weight slice, rendezvous with the sibling
+        legs, compute the full gradient ONCE per round, and reply only
+        the ``[shard_lo, shard_hi)`` slice — through the SAME encode/tree
+        tail as a flat reply, so per-shard trees and the wire codec need
+        no sharded special case."""
+        asm = self.w._ensure_shard_assembler()
+        g = asm.gradient(request, self.w.compute_gradient)
+        if g is None:
+            # a slice failed to resolve (or the rendezvous timed out):
+            # every leg of the round replies stale and the master's retry
+            # re-sends full slices on every lane
+            self.w.metrics.counter("slave.sync.stale").increment()
+            return pb.GradUpdate(stale_version=True,
+                                 shard_index=request.shard_index)
+        if self.w.telemetry and request.shard_index == 0:
+            # health gauges once per round, not once per lane — the
+            # gradient is the round's single full-dimension fan-in
+            self.w.record_health(g)
+        g_slice = np.ascontiguousarray(
+            g[request.shard_lo:request.shard_hi])
+        k = request.local_steps
+        if request.agg_parent or request.agg_children:
+            msg = self._agg_gradient(request, g_slice, k)
+        else:
+            msg = self._encode_reply(request, g_slice, k)
+        msg.shard_index = request.shard_index
+        return msg
 
     def _encode_reply(self, request, g, k):
         """The sync-reply encode tail, shared by the flat path and the

@@ -158,15 +158,16 @@ def scenario_mesh(cfg: Config, train: Dataset, test: Dataset, model) -> None:
             "DSGD_COMPRESS=%s ignored: in-mesh engines have no wire path "
             "(use engine=rpc or async_mode=gossip)", cfg.compress)
     if (cfg.local_steps > 1 or cfg.delta_broadcast or cfg.stream
-            or cfg.fanin_lanes or cfg.stage_pool or cfg.agg_tree):
+            or cfg.fanin_lanes or cfg.stage_pool or cfg.agg_tree
+            or cfg.master_shards):
         # the pipelined sync levers shape RPC wire traffic; the mesh
         # engines exchange gradients through XLA collectives
         log.warning(
             "DSGD_LOCAL_STEPS/DSGD_DELTA_BROADCAST/DSGD_STREAM/"
-            "DSGD_FANIN_LANES/DSGD_STAGE_POOL/DSGD_AGG_TREE ignored: "
-            "the pipelined sync engine is the rpc topology's (use "
-            "engine=rpc; the mesh local-SGD equivalent is "
-            "async_mode=local_sgd / sync_period)")
+            "DSGD_FANIN_LANES/DSGD_STAGE_POOL/DSGD_AGG_TREE/"
+            "DSGD_MASTER_SHARDS ignored: the pipelined sync engine is "
+            "the rpc topology's (use engine=rpc; the mesh local-SGD "
+            "equivalent is async_mode=local_sgd / sync_period)")
     if cfg.quorum is not None or cfg.chaos:
         # quorum barriers gate RPC fan-ins and chaos wraps RPC stubs; an
         # in-mesh XLA collective has neither
@@ -363,6 +364,7 @@ def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
                 stream=cfg.stream,
                 fanin_lanes=cfg.fanin_lanes, stage_pool=cfg.stage_pool,
                 agg_tree=cfg.agg_tree,
+                master_shards=cfg.master_shards,
                 quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
                 health=_health_monitor(cfg, metrics=c.master.metrics),
                 **_fit_state_args(cfg),
@@ -864,6 +866,7 @@ def _run_role(cfg: Config, role: str) -> None:
                     stream=cfg.stream,
                     fanin_lanes=cfg.fanin_lanes, stage_pool=cfg.stage_pool,
                     agg_tree=cfg.agg_tree,
+                    master_shards=cfg.master_shards,
                     quorum=cfg.quorum, straggler_soft_s=cfg.straggler_soft_s,
                     health=_health_monitor(cfg, metrics=master.metrics),
                     **_fit_state_args(cfg),

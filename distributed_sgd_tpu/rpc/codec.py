@@ -177,6 +177,76 @@ def apply_weight_delta(w: np.ndarray, delta: pb.WeightDelta) -> np.ndarray:
     return out
 
 
+class WeightSendPlan:
+    """One weight version's candidate wire forms, each encoded at most
+    once and shared across every recipient of that version.
+
+    This is the ONE versioned weight-send path (previously triplicated
+    by hand): the master's sync broadcast (core/master.py
+    `_BroadcastState`), the serving fleet's checkpoint distribution
+    (serving/push.py `WeightPusher`), and the shard lanes' range-slice
+    broadcast (shardedps/coordinator.py) all resolve their delta-vs-full
+    choice and their lazy single encodes here.  `w_prev=None` disables
+    the sparse form entirely (an unversioned / first-contact send);
+    both encodes are lazy, so an all-delta round never pays for the
+    full tensor and vice versa — the economics every caller relied on
+    before the extraction, byte-identical on the wire (the delta is
+    `encode_weight_delta`, the full form `encode_tensor`, unchanged).
+    """
+
+    def __init__(self, w: np.ndarray, w_prev: Optional[np.ndarray] = None,
+                 base_version: int = 0,
+                 break_even: float = SPARSE_BREAK_EVEN):
+        self._w = w
+        self._w_prev = w_prev
+        self.base_version = int(base_version)
+        self._break_even = float(break_even)
+        self._full: Optional[pb.Tensor] = None
+        self._delta: Optional[pb.WeightDelta] = None
+        self._delta_done = False  # "computed, dense fallback" != "not yet"
+
+    def full(self) -> pb.Tensor:
+        """The full dense tensor, encoded on first use."""
+        if self._full is None:
+            self._full = encode_tensor(self._w)
+        return self._full
+
+    def delta(self) -> Optional[pb.WeightDelta]:
+        """The sparse WeightDelta vs `w_prev`, or None when the full
+        tensor is the smaller (or only possible) wire form; computed on
+        first use."""
+        if not self._delta_done:
+            self._delta = encode_weight_delta(
+                self._w, self._w_prev, base_version=self.base_version,
+                break_even=self._break_even)
+            self._delta_done = True
+        return self._delta
+
+    def choose_arm(self, acked_version: Optional[int],
+                   version: int) -> str:
+        """The cheapest valid arm for a recipient whose last
+        acknowledged version is `acked_version` (None = no claim):
+        'cached' (zero bytes — the recipient already holds `version`),
+        'delta' (the recipient holds exactly `base_version` and the
+        sparse form exists), else 'full'."""
+        if acked_version is not None and acked_version == version:
+            return "cached"
+        if (acked_version is not None
+                and acked_version == self.base_version
+                and self.delta() is not None):
+            return "delta"
+        return "full"
+
+
+def plan_weight_send(w: np.ndarray, w_prev: Optional[np.ndarray] = None,
+                     base_version: int = 0,
+                     break_even: float = SPARSE_BREAK_EVEN) -> WeightSendPlan:
+    """Build the shared lazy encode plan for one weight version (see
+    WeightSendPlan)."""
+    return WeightSendPlan(np.asarray(w, dtype=np.float32),
+                          w_prev, base_version, break_even)
+
+
 def parse_grad(g: pb.GradUpdate):
     """Materialize a GradUpdate's wire payload into ndarrays WITHOUT
     touching any accumulator — the expensive half of `decode_grad_into`

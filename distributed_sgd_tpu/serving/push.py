@@ -108,16 +108,21 @@ class WeightPusher:
         w = np.ascontiguousarray(np.asarray(weights, dtype=np.float32))
         version = int(version)
         prev_version = self._prev[0] if self._prev is not None else None
-        delta = codec.encode_weight_delta(
+        # the shared versioned weight-send plan (rpc/codec.py
+        # WeightSendPlan) — the SAME delta-vs-full choice and lazy
+        # single encodes the sync broadcast plane and the shard lanes
+        # ride; an all-delta round never pays for the full tensor
+        plan = codec.plan_weight_send(
             w, self._prev[1] if self._prev is not None else None,
             base_version=prev_version if prev_version is not None else 0)
-        full = None  # encoded lazily: an all-delta round never pays for it
+        delta = plan.delta()
+        full = None  # the request wrapper, built lazily around plan.full()
 
         def full_req():
             nonlocal full
             if full is None:
                 full = pb.PushWeightsRequest(version=version)
-                full.weights.CopyFrom(codec.encode_tensor(w))
+                full.weights.CopyFrom(plan.full())
             return full
 
         delta_req = None

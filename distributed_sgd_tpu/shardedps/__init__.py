@@ -1,0 +1,25 @@
+"""Feature-sharded master plane (DSGD_MASTER_SHARDS,
+docs/MASTER_SHARDING.md): range-partition the weight vector across M
+master shard lanes so per-round broadcast AND fan-in bytes scale as
+dim/M per process instead of dim through one.
+
+- plan.py: the pure (dim, M) -> contiguous range partition, sha256
+  digest-stable across processes.
+- coordinator.py: the master-side shard lanes — one _BroadcastState +
+  byte ledger (+ optional reduce tree) per range — and the flat
+  single-master fallback + plan rebuild a shard loss degrades to.
+- assemble.py: the worker-side slice rendezvous — M range-tagged
+  requests assemble one full weight vector, the gradient is computed
+  ONCE, and each lane's reply carries its range slice.
+
+Everything is default-off: with the knob unset no plan is built, no
+lane or assembler is constructed, no instrument registers, and the
+wire is byte-identical to the flat master (proto3 unset shard fields
+serialize to nothing — asserted by tests/test_shardedps.py).
+"""
+
+from distributed_sgd_tpu.shardedps.plan import (  # noqa: F401
+    ShardPlan,
+    build_shard_plan,
+    parse_master_shards,
+)

@@ -1,0 +1,189 @@
+"""Worker-side shard rendezvous for the feature-sharded master plane
+(DSGD_MASTER_SHARDS, docs/MASTER_SHARDING.md).
+
+A sharded round reaches each worker as M concurrent Gradient requests —
+one per master shard lane, each carrying only its range slice of the
+weight vector (full tensor, WeightDelta vs the lane's previous version,
+or a header-only cached form).  The gradient, however, is a function of
+the WHOLE weight vector: hinge-loss backprop reads every feature a
+sample touches.  ``ShardAssembler`` is the meeting point:
+
+- each request resolves ITS slice against the per-shard resident cache
+  (the same install/cached/delta/stale ladder as the flat replica,
+  core/worker.py ``resolve_request_weights``, keyed per shard index);
+- the M requests of one round rendezvous on ``(fit_token, shard_round)``;
+  the request that completes the set assembles the full vector from the
+  range slices and computes the gradient ONCE;
+- every request then slices the shared gradient by its own
+  ``[shard_lo, shard_hi)`` and replies it up its own lane — so the
+  per-worker compute cost is identical to a flat round while the wire
+  cost scales down per shard.
+
+Any slice that fails to resolve marks the whole round stale: all M
+replies come back ``stale_version`` and the master's retry re-sends full
+slices on every lane (each lane dropped its version claim), exactly the
+flat plane's correctness fallback.  Abandoned rounds (master retried,
+shard died mid-flight) age out of a bounded buffer, mirroring the
+aggregation tree's reduce buffer discipline (aggtree/reduce.py).
+
+Constructed lazily on the first shard-tagged request
+(``WorkerNode._ensure_shard_assembler``): a knobs-off worker never
+builds one and never registers a shard instrument
+(tests/test_shardedps.py identity gate).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from distributed_sgd_tpu.rpc import codec
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+# how long a request waits for its round's sibling slices before replying
+# stale — generous vs the master's per-round deadline, because the wait
+# covers only the skew between M sends of the SAME round (microseconds on
+# a healthy wire), not a round-trip
+ASSEMBLE_BUDGET_S = 5.0
+
+# bounded rendezvous buffer: rounds the master abandoned (retry bumped
+# shard_round, shard lane died mid-flight) must not leak — the oldest
+# round is evicted, its waiters woken to reply stale for a round nobody
+# will collect
+MAX_PENDING_ROUNDS = 8
+
+
+class _Round:
+    """One shard round's rendezvous state (guarded by the assembler lock)."""
+
+    __slots__ = ("slices", "stale", "grad", "done", "computing")
+
+    def __init__(self):
+        # shard_index -> (lo, hi, slice ndarray)
+        self.slices = {}
+        self.stale = False
+        self.grad: Optional[np.ndarray] = None
+        self.done = False
+        self.computing = False
+
+
+class ShardAssembler:
+    def __init__(self, metrics=None, log=None):
+        if metrics is None:
+            metrics = metrics_mod.global_metrics()
+        self.metrics = metrics
+        self.log = log
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        # geometry = (fit_token, shard_count): a new fit session or a
+        # rebuilt shard plan (kill -> M-1 lanes, different ranges) resets
+        # every per-shard resident — slices cached under the old ranges
+        # have the wrong extents
+        self._geometry = None
+        # shard_index -> (version, slice ndarray)
+        self._resident = {}
+        # (fit_token, shard_round) -> _Round
+        self._rounds: "OrderedDict[tuple, _Round]" = OrderedDict()
+
+    # -- per-shard slice resolution (caller holds the lock) -----------------
+
+    def _resolve_slice(self, request):
+        """The flat replica ladder, per shard index: install / cached /
+        delta / stale.  Returns (slice, stale)."""
+        i = int(request.shard_index)
+        version = request.step_version
+        if request.HasField("weights"):
+            sl = codec.decode_tensor(request.weights)
+            self._resident[i] = (version, sl)
+            return sl, False
+        held = self._resident.get(i)
+        if held is None:
+            return None, True
+        cached_ver, cached = held
+        if cached_ver == version:
+            return cached, False  # retry / already-applied: idempotent
+        if request.HasField("delta") and cached_ver == request.delta.base_version:
+            sl = codec.apply_weight_delta(cached, request.delta)
+            self._resident[i] = (version, sl)
+            return sl, False
+        return None, True
+
+    def _round_for(self, key) -> _Round:
+        rd = self._rounds.get(key)
+        if rd is None:
+            rd = _Round()
+            self._rounds[key] = rd
+            while len(self._rounds) > MAX_PENDING_ROUNDS:
+                _, old = self._rounds.popitem(last=False)
+                # wake the abandoned round's waiters: they reply stale
+                # for a round the master already moved past
+                old.stale = True
+                old.done = True
+                self._cv.notify_all()
+        return rd
+
+    # -- the rendezvous -----------------------------------------------------
+
+    def gradient(self, request,
+                 compute: Callable[[np.ndarray, np.ndarray], np.ndarray]
+                 ) -> Optional[np.ndarray]:
+        """Resolve this request's slice, rendezvous with its round's
+        siblings, and return the round's FULL-dimension gradient (shared,
+        read-only — the caller slices its own range) or None (stale slice
+        anywhere in the round, abandoned round, or rendezvous timeout)."""
+        rkey = (request.fit_token, int(request.shard_round))
+        count = int(request.shard_count)
+        with self._cv:
+            geometry = (request.fit_token, count)
+            if self._geometry != geometry:
+                self._resident.clear()
+                self._geometry = geometry
+            sl, stale = self._resolve_slice(request)
+            rd = self._round_for(rkey)
+            if stale or rd.stale:
+                rd.stale = True
+                rd.done = True
+                self._cv.notify_all()
+                return None
+            rd.slices[int(request.shard_index)] = (
+                int(request.shard_lo), int(request.shard_hi), sl)
+            assemble = len(rd.slices) == count and not rd.computing
+            if assemble:
+                # claim the compute before dropping the lock: exactly one
+                # thread per round assembles and runs the backward pass
+                rd.computing = True
+                pieces = dict(rd.slices)
+        if assemble:
+            dim = max(hi for _, hi, _ in pieces.values())
+            w = np.empty(dim, dtype=np.float32)
+            for lo, hi, piece in pieces.values():
+                w[lo:hi] = piece
+            ids = np.fromiter(request.samples, dtype=np.int64)
+            g = np.asarray(compute(w, ids), dtype=np.float32)
+            with self._cv:
+                rd.grad = g
+                rd.done = True
+                self._cv.notify_all()
+            self.metrics.counter(metrics_mod.SHARD_ASSEMBLED).increment()
+            return g
+        deadline = time.monotonic() + ASSEMBLE_BUDGET_S
+        with self._cv:
+            while not rd.done:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    rd.stale = True
+                    rd.done = True
+                    self._cv.notify_all()
+                    self.metrics.counter(
+                        metrics_mod.SHARD_ASM_TIMEOUTS).increment()
+                    if self.log is not None:
+                        self.log.warning(
+                            "shard round %s timed out waiting for %d/%d "
+                            "slices", rkey, len(rd.slices), count)
+                    return None
+                self._cv.wait(remaining)
+            return rd.grad if not rd.stale else None

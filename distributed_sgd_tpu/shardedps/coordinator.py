@@ -1,0 +1,362 @@
+"""Master-side shard lanes for the feature-sharded master plane
+(DSGD_MASTER_SHARDS, docs/MASTER_SHARDING.md).
+
+The flat sync engine is O(dim x N) on ONE master endpoint in both
+directions: every round broadcasts the full weight vector to N workers
+and decodes N full-dimension gradients.  ``ShardedCoordinator``
+range-partitions that traffic across M shard lanes (shardedps/plan.py —
+contiguous ranges, pure function of ``(dim, M)``): each lane owns its own
+versioned broadcast state over its slice (the SAME ``_BroadcastState``
+delta/codec machinery the flat plane runs, so delta broadcasts compose
+per lane), its own per-shard aggregation tree when DSGD_AGG_TREE is on
+(shard-colored: the tree seed is offset by the shard index, so different
+lanes elect different aggregators and the reduce fan-in load spreads),
+and its own byte ledger — the per-process wire cost ``bench.py --scale``
+gates on is the MAX over lanes, not the sum.
+
+Correctness is commutativity, not consensus: hinge-loss SGD applies
+``w -= lr * mean(grads)`` coordinate-wise, so range-disjoint slices
+applied independently land on the bit-identical weight vector the flat
+engine produces — asserted per round by the bench sweep.  A worker is
+good for a round only if EVERY lane's leg succeeded; any stale or failed
+leg degrades the worker exactly as the flat plane would (one failure per
+round per worker — M failed legs are ONE liveness strike, never M).
+
+Failure plane (docs/MASTER_SHARDING.md "failure matrix"): ``kill(i)``
+(the bench chaos hook, ``MasterNode.kill_shard``) marks lane *i* dead.
+The next window dispatches ONE flat single-master fallback round —
+untagged full-weight requests, classic barrier, zero special-casing on
+the workers — then the plan rebuilds over the surviving lanes before the
+following window, so exactly the affected rounds degrade and no live
+worker is ever evicted for a master-side death.  All lanes dead leaves
+the fit in permanent flat fallback: the fit completes, the perf win is
+gone.
+
+Constructed only by ``MasterNode.fit_sync`` when the knob is on; the
+knobs-off fit never imports this module, registers no shard instrument,
+and keeps the wire byte-identical (tests/test_shardedps.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+import numpy as np
+
+from distributed_sgd_tpu.core.master import _BroadcastState
+from distributed_sgd_tpu.rpc import codec, dsgd_pb2 as pb
+from distributed_sgd_tpu.shardedps.plan import build_shard_plan
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import metrics as metrics_mod
+
+
+class ShardLane:
+    """One master shard: a contiguous feature range, its versioned
+    broadcast state over the slice wire, its shard-colored reduce tree,
+    and its byte ledger."""
+
+    def __init__(self, index: int, lo: int, hi: int, delta_broadcast: bool,
+                 metrics):
+        self.index = int(index)
+        self.lo = int(lo)
+        self.hi = int(hi)
+        # encode_ahead off: M lanes spawning M encoder threads buys
+        # nothing for dim/M-sized slices — the per-lane encode is already
+        # off the O(dim) critical path by construction
+        self.bcast = _BroadcastState(delta_broadcast, metrics,
+                                     encode_ahead=False)
+        self.tree_plan = None
+        self.bcast_bytes = 0
+        self.grad_bytes = 0
+        self.killed = False
+
+
+class ShardedCoordinator:
+    """Per-fit shard fan-out/fan-in driver, called from fit_sync's hook
+    points (plan build, membership rebuild, dispatch, collect,
+    accumulate, advance) — the registration/liveness/resplit surface
+    stays the flat master's."""
+
+    def __init__(self, master, shards: int, dim: int, keys,
+                 delta_broadcast: bool, tree_fanout: int,
+                 grad_timeout_s: float):
+        self.master = master
+        self.metrics = master.metrics
+        self.log = master.log
+        self.dim = int(dim)
+        self.delta_broadcast = bool(delta_broadcast)
+        self.tree_fanout = int(tree_fanout or 0)
+        self.grad_timeout_s = float(grad_timeout_s)
+        self.plan = build_shard_plan(self.dim, shards)
+        self._keys = list(keys)
+        self._lanes: List[ShardLane] = []
+        # chaos plane: kill() may land from any thread mid-round; the
+        # fit thread absorbs it at the next dispatch boundary
+        self._kill_lock = threading.Lock()
+        self._killed: set = set()
+        self._permanent_flat = False
+        self._flat_round = False
+        # in-flight round: ("sharded" | "flat", [(key, lane|None, fut)])
+        self._round: Optional[Tuple[str, list]] = None
+        self._collected: List[tuple] = []
+        self._bcast_total = self.metrics.counter(
+            metrics_mod.SHARD_BCAST_BYTES)
+        self._grad_total = self.metrics.counter(metrics_mod.SHARD_GRAD_BYTES)
+        self._build_lanes()
+        flight.record("shard.plan", shards=self.plan.shards, dim=self.dim,
+                      digest=self.plan.digest()[:12])
+        self.log.info("sharded master plane: %r", self.plan)
+
+    # -- plan / lane lifecycle ----------------------------------------------
+
+    def _build_lanes(self) -> None:
+        self._lanes = [
+            ShardLane(i, lo, hi, self.delta_broadcast, self.metrics)
+            for i, (lo, hi) in enumerate(self.plan.ranges)
+        ]
+        self.metrics.gauge(metrics_mod.SHARD_COUNT).set(len(self._lanes))
+        if self.tree_fanout:
+            self._build_lane_trees()
+
+    def _build_lane_trees(self) -> None:
+        """One reduce tree PER LANE, shard-colored: the plan seed is
+        offset by the shard index, so per-host rotation elects different
+        aggregators lane to lane and no single worker carries every
+        shard's reduce fan-in (aggtree/plan.py build_plan)."""
+        from distributed_sgd_tpu.aggtree import build_plan
+
+        for lane in self._lanes:
+            lane.tree_plan = build_plan(self._keys, self.tree_fanout,
+                                        seed=self.master.seed + lane.index)
+
+    def on_membership(self, keys) -> None:
+        """Rides fit_sync's membership-rebuild block — the same hook the
+        resplit and the flat tree rebuild fire on, so shard trees and
+        version claims always describe the same membership snapshot."""
+        self._keys = list(keys)
+        if self.tree_fanout:
+            self._build_lane_trees()
+        for lane in self._lanes:
+            lane.bcast.forget_missing(keys)
+
+    def kill(self, index: int) -> None:
+        """Chaos hook: mark shard `index` dead.  Takes effect at the next
+        dispatch boundary — one flat fallback round, then a plan rebuild
+        over the survivors (benches/bench_scale.py chaos row)."""
+        with self._kill_lock:
+            lanes = {lane.index for lane in self._lanes}
+            if index not in lanes:
+                raise ValueError(
+                    f"no live master shard {index} (live: {sorted(lanes)})")
+            self._killed.add(int(index))
+        for lane in self._lanes:
+            if lane.index == index:
+                lane.killed = True
+        self.log.warning("master shard %d killed; next window falls back "
+                         "to the flat single-master plane", index)
+        flight.record("shard.kill", shard=int(index))
+
+    def bytes_by_lane(self) -> List[Tuple[int, int, int]]:
+        """[(shard_index, broadcast_bytes, gradient_bytes)] for the LIVE
+        lanes — the per-process wire ledger bench.py --scale's gate reads
+        (max over lanes vs the flat plane's single-process total)."""
+        return [(lane.index, lane.bcast_bytes, lane.grad_bytes)
+                for lane in self._lanes]
+
+    # -- per-round hooks ----------------------------------------------------
+
+    def dispatch(self, members, ids_by_key, w: np.ndarray, fit_token: int,
+                 grad_timeout_s: float, agg_round_seq: int) -> int:
+        """Fan this window out: M tagged slice requests per worker (one
+        per lane), or ONE untagged flat request per worker when a shard
+        kill is being absorbed.  Returns the new agg_round_seq — sharded
+        rounds consume one tree round PER LANE so a stale child push from
+        an abandoned attempt can never alias another lane's round."""
+        with self._kill_lock:
+            fallback = bool(self._killed) or self._permanent_flat
+        if fallback:
+            return self._dispatch_flat(members, ids_by_key, w, fit_token,
+                                       grad_timeout_s, agg_round_seq)
+        self._flat_round = False
+        shard_round = int(agg_round_seq)
+        futs = []
+        for lane in self._lanes:
+            w_slice = w[lane.lo:lane.hi]
+            for key, stub in members:
+                ids = ids_by_key[key]
+                req = pb.GradientRequest(samples=ids.astype(np.int32),
+                                         fit_token=fit_token)
+                req.shard_index = lane.index
+                req.shard_count = len(self._lanes)
+                req.shard_lo = lane.lo
+                req.shard_hi = lane.hi
+                req.shard_round = shard_round
+                form, nbytes = lane.bcast._attach_arm(req, key, w_slice)
+                metrics_mod.record_broadcast(self.metrics, form, nbytes)
+                self._bcast_total.increment(nbytes)
+                lane.bcast_bytes += nbytes
+                if lane.tree_plan is not None and not lane.tree_plan.trivial:
+                    self.master._annotate_tree(
+                        req, key, lane.tree_plan,
+                        agg_round_seq + lane.index, grad_timeout_s)
+                fut = self.master._dispatch_gradient(
+                    key, stub, None, req, grad_timeout_s, False)
+                futs.append((key, lane, fut))
+        self._round = ("sharded", futs)
+        return agg_round_seq + len(self._lanes) - 1
+
+    def _dispatch_flat(self, members, ids_by_key, w, fit_token,
+                       grad_timeout_s, agg_round_seq: int) -> int:
+        """The degraded round: classic untagged full-weight requests —
+        the workers run their flat path, no shard state involved, so a
+        master-shard death costs performance for exactly this round and
+        never a worker eviction."""
+        self._flat_round = True
+        self.metrics.counter(metrics_mod.SHARD_FALLBACK_ROUNDS).increment()
+        flight.record("shard.fallback", killed=sorted(self._killed),
+                      permanent=self._permanent_flat)
+        # evidence before recovery, throttled like the quorum dump: a
+        # permanent-flat fit degrades EVERY window
+        flight.dump("shard-kill", min_interval_s=10.0)
+        send = codec.plan_weight_send(w)  # full-only plan, encoded once
+        futs = []
+        for key, stub in members:
+            ids = ids_by_key[key]
+            req = pb.GradientRequest(samples=ids.astype(np.int32),
+                                     fit_token=fit_token)
+            full = send.full()
+            req.weights.CopyFrom(full)
+            metrics_mod.record_broadcast(self.metrics, "full",
+                                         full.ByteSize())
+            fut = self.master._dispatch_gradient(
+                key, stub, None, req, grad_timeout_s, False)
+            futs.append((key, None, fut))
+        self._round = ("flat", futs)
+        return agg_round_seq
+
+    def collect(self, grad_bytes):
+        """Barrier over this round's M x N (or flat N) legs with
+        per-WORKER collapse: good iff every leg arrived non-stale; any
+        stale leg -> stale (every lane drops its claim, full slices on
+        the retry); failures DEDUPED per worker so M dead legs are one
+        liveness strike.  Returns (good, stale, failed) shaped like the
+        flat barrier's lists."""
+        kind, futs = self._round
+        self._collected = []
+        failed: Dict[tuple, object] = {}
+        stale_keys: List[tuple] = []
+        arrived: Dict[tuple, int] = {}
+        for key, lane, fut in futs:
+            try:
+                if fut is None:
+                    raise ValueError("channel closed")
+                reply = fut.result()
+                nbytes = reply.ByteSize()
+                grad_bytes.increment(nbytes)
+                if lane is not None:
+                    self._grad_total.increment(nbytes)
+                    lane.grad_bytes += nbytes
+                if reply.stale_version:
+                    if key not in stale_keys:
+                        stale_keys.append(key)
+                else:
+                    arrived[key] = arrived.get(key, 0) + 1
+                    self._collected.append((key, lane, reply))
+            except (grpc.RpcError, ValueError) as e:
+                failed.setdefault(
+                    key, e.code() if isinstance(e, grpc.RpcError) else e)
+        expect = len(self._lanes) if kind == "sharded" else 1
+        good, stale = [], []
+        seen = set()
+        for key, lane, fut in futs:
+            if key in seen:
+                continue
+            seen.add(key)
+            if key in failed:
+                continue
+            if key in stale_keys or arrived.get(key, 0) != expect:
+                # a short-counted worker (some legs stale-dropped by the
+                # assembler's rendezvous, others fine) is stale, not
+                # dead: full slices on the retry re-sync every lane
+                if key not in stale_keys:
+                    stale_keys.append(key)
+                stale.append((key, None))
+                for ln in self._lanes:
+                    ln.bcast.note_stale(key)
+                continue
+            good.append((key, None))
+            for ln in self._lanes:
+                ln.bcast.note_ok(key)
+        return good, stale, [(k, c) for k, c in failed.items()]
+
+    def accumulate(self, grad_acc: np.ndarray) -> None:
+        """Range-disjoint fan-in: each lane decodes its replies into its
+        OWN view of the accumulator in canonical (dispatch) worker order
+        and scales by its own contributor count — per coordinate this is
+        the flat barrier's exact float chain (same worker order, same
+        single true-divide), which is what makes the sharded step
+        bit-identical to the unsharded one."""
+        grad_acc.fill(0.0)
+        kind, _ = self._round
+        if kind == "flat":
+            replies = [r for _, _, r in self._collected]
+            for r in replies:
+                codec.decode_grad_into(r, grad_acc)
+            grad_acc /= len(replies)
+            return
+        for lane in self._lanes:
+            view = grad_acc[lane.lo:lane.hi]
+            lane_replies = [r for _, ln, r in self._collected if ln is lane]
+            treed = lane.tree_plan is not None and not lane.tree_plan.trivial
+            n_contrib = 0
+            for r in lane_replies:
+                codec.decode_grad_into(r, view)
+                if r.agg_contributors:
+                    n_contrib += len(r.agg_contributors)
+                elif not r.agg_forwarded:
+                    n_contrib += 1
+                if r.agg_partial:
+                    self.metrics.counter(
+                        metrics_mod.TREE_PARTIAL).increment()
+                if r.agg_flat:
+                    self.metrics.counter(
+                        metrics_mod.TREE_FLAT_FALLBACK).increment()
+            if treed:
+                view /= max(1, n_contrib)
+            else:
+                view /= len(lane_replies)
+        self.metrics.counter(metrics_mod.SHARD_ROUNDS).increment()
+
+    def advance(self, w_new: np.ndarray, w_old: np.ndarray) -> None:
+        """Post-apply hook: advance every lane's broadcast version over
+        its slice — or, after a fallback round, absorb the kill by
+        rebuilding the plan over the surviving shard count (fresh lanes,
+        full broadcasts next round; the workers' assemblers reset on the
+        geometry change)."""
+        if self._flat_round:
+            self._flat_round = False
+            with self._kill_lock:
+                killed = set(self._killed)
+                self._killed.clear()
+            if not killed:
+                return  # permanent flat: nothing left to rebuild
+            survivors = len(self._lanes) - len(killed)
+            if survivors < 1:
+                self._permanent_flat = True
+                self.metrics.gauge(metrics_mod.SHARD_COUNT).set(0)
+                self.log.error("every master shard is dead: continuing in "
+                               "permanent flat fallback")
+                return
+            self.plan = build_shard_plan(self.dim, survivors)
+            self._build_lanes()
+            self.metrics.counter(metrics_mod.SHARD_REBUILDS).increment()
+            flight.record("shard.rebuild", shards=survivors,
+                          digest=self.plan.digest()[:12])
+            self.log.warning("shard plan rebuilt over %d surviving "
+                             "shard(s): %r", survivors, self.plan)
+            return
+        for lane in self._lanes:
+            lane.bcast.advance(w_new[lane.lo:lane.hi],
+                               w_old[lane.lo:lane.hi])

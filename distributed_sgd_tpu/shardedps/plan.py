@@ -1,0 +1,109 @@
+"""Deterministic feature-range shard plan for the sharded master plane
+(docs/MASTER_SHARDING.md, DSGD_MASTER_SHARDS).
+
+``build_shard_plan`` is a PURE function of ``(dim, shards)``: every
+process that knows the model dimension and the shard count computes the
+byte-identical range partition (asserted via ``ShardPlan.digest`` by
+tests/test_shardedps.py), so the coordinator can rebuild it after a
+shard loss without a coordination round — the same purity contract the
+reduce-tree plan (aggtree/plan.py) and the split functions
+(core/split.py) rely on.
+
+Shape: the weight vector's ``dim`` coordinates are carved into
+``shards`` contiguous near-even ``[lo, hi)`` ranges — the SAME carve
+rule as the reduce tree's chunking and core/split.py's contiguous
+splits (sizes differ by at most one, larger ranges first), so an
+awkward ``dim % shards != 0`` still covers every coordinate exactly
+once.  Contiguity is what makes the per-shard traffic cheap: a slice
+of a dense f32 tensor is a memcpy, a sparse gradient's ids bucket by
+one range comparison (the dp×tp mesh engine proves the same algebra in
+parallel/feature_sharded.py), and a WeightDelta in shard frame is just
+the master delta restricted to ``[lo, hi)`` and shifted.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import List, Optional, Tuple
+
+# one carve rule for the whole codebase: the reduce tree's near-even
+# contiguous chunking is exactly the range partition a feature shard
+# needs (larger chunks first, sizes differ by <= 1)
+from distributed_sgd_tpu.aggtree.plan import _chunks
+
+
+def parse_master_shards(value: Optional[object]) -> int:
+    """DSGD_MASTER_SHARDS grammar -> shard count (0 = off).
+
+    Accepts None/""/0 (off) or an integer M >= 1.  The strict grammar
+    is the config-validation contract: config.py delegates here so a
+    typo fails at startup, not mid-fit.  M=1 is legal — the degenerate
+    single-shard plane exercises the full sharded wire (range-tagged
+    requests, worker-side assembly) with one lane, which is what the
+    bench's M=1 sweep row pins."""
+    if value is None or value == "":
+        return 0
+    try:
+        shards = int(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"DSGD_MASTER_SHARDS must be an integer >= 0, got {value!r}")
+    if shards < 0:
+        raise ValueError(
+            f"DSGD_MASTER_SHARDS must be >= 0 (0 = off), got {shards}")
+    return shards
+
+
+class ShardPlan:
+    """One immutable range partition of a ``dim``-long weight vector.
+
+    ``ranges[i]`` is shard i's contiguous ``[lo, hi)`` feature range;
+    ranges are ascending, disjoint, and cover ``[0, dim)`` exactly.
+    The plan is a value object — rebuilding at the same ``(dim,
+    shards)`` lands on the byte-identical plan, which ``digest()``
+    witnesses across processes."""
+
+    def __init__(self, dim: int, shards: int):
+        dim = int(dim)
+        shards = int(shards)
+        if dim < 1:
+            raise ValueError(f"shard plan needs dim >= 1, got {dim}")
+        if shards < 1:
+            raise ValueError(f"shard plan needs shards >= 1, got {shards}")
+        self.dim = dim
+        # more shards than coordinates degenerates to one shard per
+        # coordinate (the _chunks clamp), never an empty range
+        self.shards = min(shards, dim)
+        self.ranges: Tuple[Tuple[int, int], ...] = tuple(
+            _chunks(dim, self.shards))
+
+    def range_of(self, index: int) -> Tuple[int, int]:
+        return self.ranges[index]
+
+    def digest(self) -> str:
+        """sha256 over the canonical (dim, ranges) JSON — the
+        cross-process byte-identity witness tests/test_shardedps.py
+        pins (mirrors TreePlan.digest)."""
+        blob = json.dumps(
+            {"dim": self.dim, "ranges": [list(r) for r in self.ranges]},
+            separators=(",", ":"), sort_keys=True)
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def __repr__(self):
+        sizes = [hi - lo for lo, hi in self.ranges]
+        return (f"ShardPlan(dim={self.dim}, shards={self.shards}, "
+                f"range_sizes={min(sizes)}..{max(sizes)})")
+
+
+def build_shard_plan(dim: int, shards: int) -> ShardPlan:
+    """(model dimension, shard count) -> deterministic range partition.
+
+    Pure: no RNG, no wall clock, no membership — the plan depends on
+    nothing a restarted or remote process could disagree about."""
+    return ShardPlan(dim, shards)
+
+
+def slice_ranges(plan: ShardPlan) -> List[Tuple[int, int, int]]:
+    """[(index, lo, hi)] convenience view for coordinator fan-out."""
+    return [(i, lo, hi) for i, (lo, hi) in enumerate(plan.ranges)]

@@ -553,6 +553,21 @@ AGG_PARTIAL = "slave.agg.partial"                # counter: reduced rounds missi
 AGG_FLAT = "slave.agg.flat"                      # counter: dead-parent flat fallbacks (child side)
 
 
+# -- sharded master plane (shardedps/; docs/MASTER_SHARDING.md) ---------------
+# Registered only when DSGD_MASTER_SHARDS builds a shard plan: the
+# coordinator side at lane build, the worker side when its ShardAssembler
+# is lazily constructed — knobs-off, none of these exist
+# (tests/test_shardedps.py).
+SHARD_COUNT = "master.shard.count"               # gauge: lanes in the live shard plan
+SHARD_ROUNDS = "master.shard.rounds"             # counter: sharded fan-out rounds
+SHARD_REBUILDS = "master.shard.rebuilds"         # counter: plan rebuilds after a shard loss
+SHARD_FALLBACK_ROUNDS = "master.shard.fallback_rounds"  # counter: flat single-master rounds
+SHARD_BCAST_BYTES = "master.shard.bcast.bytes"   # counter: slice broadcast bytes, all lanes
+SHARD_GRAD_BYTES = "master.shard.grad.bytes"     # counter: slice fan-in bytes, all lanes
+SHARD_ASSEMBLED = "slave.shard.assembled"        # counter: rendezvous rounds computed once
+SHARD_ASM_TIMEOUTS = "slave.shard.timeouts"      # counter: rendezvous waits that expired stale
+
+
 # which sparse-scatter formulation the process's kernels run (DSGD_SCATTER,
 # ops/mxu.py; ROADMAP item 2 follow-up): gauge value indexes
 # mxu.SCATTER_FORMULATIONS ('onehot'=0, 'segment'=1, 'twostage'=2,

@@ -442,3 +442,106 @@ def test_hedge_scratch_leaves_donor_resident_untouched(data, model_fn):
         b = donor.compute_gradient(w, own_ids)
         assert np.array_equal(a, b)
         assert g.counter(mm.HEDGE_SCRATCH).value == scratch0 + 1
+
+
+# -- 6. contributor-weighted quorum (ISSUE 18 satellite) ----------------------
+
+
+class _SettleFut:
+    """Minimal future for _await_quorum: done/result/add_done_callback."""
+
+    def __init__(self, reply=None):
+        self._reply = reply
+        self._done = reply is not None
+        self._cbs = []
+
+    def done(self):
+        return self._done
+
+    def result(self):
+        assert self._done, "result() read on a pending future"
+        return self._reply
+
+    def add_done_callback(self, cb):
+        if self._done:
+            cb(self)
+        else:
+            self._cbs.append(cb)
+
+    def settle(self, reply):
+        self._reply = reply
+        self._done = True
+        for cb in self._cbs:
+            cb(self)
+
+
+def test_reply_weight_grammar():
+    """A subtree sum weighs its contributor set, a forwarded ack weighs
+    ZERO, and every flat shape (plain GradUpdate, ForwardReply) weighs
+    one — so tree-off quorum counting is unchanged."""
+    from distributed_sgd_tpu.core.master import _reply_weight
+
+    assert _reply_weight(pb.GradUpdate()) == 1
+    assert _reply_weight(pb.GradUpdate(stale_version=True)) == 1
+    assert _reply_weight(pb.GradUpdate(agg_forwarded=True)) == 0
+    assert _reply_weight(
+        pb.GradUpdate(agg_contributors=["a:1", "b:2", "c:3"])) == 3
+    # an aggregator's own reply lists itself among the contributors, so
+    # the forwarded flag (if any) never double-counts
+    assert _reply_weight(
+        pb.GradUpdate(agg_contributors=["a:1"], agg_forwarded=True)) == 1
+    assert _reply_weight(pb.ForwardReply()) == 1
+
+
+def test_await_quorum_forwarded_acks_do_not_satisfy():
+    """Q armless acks in hand must NOT close the round: their gradients
+    ride a still-straggling aggregator's reply.  The barrier keeps
+    waiting past the soft deadline until the subtree sum lands."""
+    from distributed_sgd_tpu.core.master import _await_quorum
+
+    acks = [_SettleFut(pb.GradUpdate(agg_forwarded=True)) for _ in range(3)]
+    agg = _SettleFut()
+    futs = [(("h", i), f) for i, f in enumerate(acks)] + [(("h", 9), agg)]
+    timer = threading.Timer(
+        0.4, agg.settle,
+        args=(pb.GradUpdate(agg_contributors=["a", "b", "c", "d"]),))
+    timer.start()
+    t0 = time.monotonic()
+    try:
+        ok, failed, pending = _await_quorum(
+            futs, quorum=3, soft_deadline=t0 - 1.0)
+    finally:
+        timer.cancel()
+    assert time.monotonic() - t0 >= 0.3, (
+        "the barrier exited on ack COUNT — 3 forwarded acks carry zero "
+        "gradient mass and must not satisfy quorum=3")
+    assert not pending and not failed and len(ok) == 4
+
+
+def test_await_quorum_subtree_sum_satisfies_alone():
+    """One root reply covering >= Q contributors relieves the barrier by
+    itself — reply COUNT 1 is quorum mass 4."""
+    from distributed_sgd_tpu.core.master import _await_quorum
+
+    agg = _SettleFut(pb.GradUpdate(agg_contributors=["a", "b", "c", "d"]))
+    never = _SettleFut()
+    futs = [(("h", 1), agg), (("h", 2), never)]
+    ok, failed, pending = _await_quorum(
+        futs, quorum=4, soft_deadline=time.monotonic() - 1.0)
+    assert [k for k, _ in ok] == [("h", 1)]
+    assert not failed
+    assert [k for k, _ in pending] == [("h", 2)]
+
+
+def test_quorum_over_tree_fit_completes_and_parities_flat(data, model_fn):
+    """End-to-end quorum + DSGD_AGG_TREE: the weighted count closes
+    healthy rounds (no spurious below-quorum degradation) and the fit
+    lands within the usual f32-reassociation band of the flat run."""
+    train, test = data
+    with DevCluster(model_fn(), train, test, n_workers=8) as c:
+        flat = _fit(c)
+        w_flat = np.asarray(flat.state.weights)
+        res = _fit(c, agg_tree="fanout:2", quorum=4, hedge=False)
+        assert res.epochs_run == 2
+        np.testing.assert_allclose(np.asarray(res.state.weights), w_flat,
+                                   rtol=0, atol=1e-5)

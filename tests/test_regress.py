@@ -309,3 +309,63 @@ def test_scale_eff_is_a_higher_is_better_class():
         {"metric": "scale_full", "n32_scale_eff": 0.90}, hist,
         tolerance=0.35)
     assert ok == []
+
+
+def test_bytes_reduction_is_a_higher_is_better_class():
+    """Shard-sweep bytes reduction (`*_bytes_reduction`,
+    benches/bench_scale.py) gates UP with the wire-shaped 10% band — and
+    must never fall through to the `_bytes` lower-is-better rule, which
+    would gate a BIGGER reduction as re-inflated wire."""
+    assert regress.direction("m4_n32_bytes_reduction") == "up"
+    assert regress.direction("shard_bytes_reduction") == "up"
+    assert regress.tolerance_for("m4_n32_bytes_reduction") == 0.10
+    hist = [{"metric": "scale_full", "m4_n32_bytes_reduction": 3.6}] * 3
+    regs, lines = regress.check(
+        {"metric": "scale_full", "m4_n32_bytes_reduction": 2.0}, hist,
+        tolerance=0.35)
+    assert regs == ["m4_n32_bytes_reduction"]
+    assert any("[up," in ln for ln in lines)
+    ok, _ = regress.check(
+        {"metric": "scale_full", "m4_n32_bytes_reduction": 4.2}, hist,
+        tolerance=0.35)
+    assert ok == []  # a bigger reduction can never regress
+    # the per-lane rows themselves stay in the plain bytes class
+    assert regress.direction("m4_n32_proc_bytes") == "down"
+    assert regress.tolerance_for("m4_n32_proc_bytes") == 0.10
+
+
+def test_shard_rows_split_into_their_own_history_series():
+    """benches/bench_scale.py records the shard sweep as its own series
+    (`scale_shard_*`): the rows are deterministic bytes, so a noisy
+    wall-clock day must not block appending them (regress.py's
+    series-independence rule).  The split must route every shard row —
+    the m{M}_n{N} matrix, the flat per-process baselines, the gate and
+    chaos summaries — and nothing else."""
+    from benches.bench_scale import split_shard_series
+
+    combined = {
+        "metric": "scale_smoke", "value": 0.05, "unit": "s/round",
+        "n32_scaled_rounds_per_s": 20.0, "n32_drift": 0.0,
+        "chaos_flat_fallbacks": 2, "tree_fanout": 8,
+        "n32_flat_proc_bytes": 1072911,
+        "m4_n32_proc_bytes": 281495, "m4_n32_bytes_reduction": 3.811,
+        "shard_gate_m": 4, "shard_gate_n": 32,
+        "shard_bytes_reduction": 3.811,
+        "shard_chaos_live_evictions": 0,
+    }
+    timing, shard = split_shard_series(combined)
+    assert timing["metric"] == "scale_smoke"
+    assert shard["metric"] == "scale_shard_smoke"
+    # the shard series' headline is the gate point's per-process bytes
+    assert (shard["value"], shard["unit"]) == (281495, "bytes")
+    assert set(shard) == {
+        "metric", "value", "unit", "n32_flat_proc_bytes",
+        "m4_n32_proc_bytes", "m4_n32_bytes_reduction", "shard_gate_m",
+        "shard_gate_n", "shard_bytes_reduction",
+        "shard_chaos_live_evictions"}
+    # the timing series keeps everything else, shard-free
+    assert set(timing) == {
+        "metric", "value", "unit", "n32_scaled_rounds_per_s",
+        "n32_drift", "chaos_flat_fallbacks", "tree_fanout"}
+    # a run with no shard rows (e.g. a trimmed sweep) yields no series
+    assert split_shard_series({"metric": "scale_smoke"})[1] == {}

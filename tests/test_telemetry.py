@@ -267,19 +267,22 @@ def test_new_proto_surface_leaves_existing_messages_untouched():
     message keeps its exact field list, so the default wire stays
     byte-identical by construction (unset proto3 fields serialize to
     nothing, and no field was added to be unset).  The aggregation-tree
-    `agg_*` fields on GradientRequest/GradUpdate are the one later
-    extension to existing messages — pinned here so any further growth
-    is a conscious edit, with their unset-is-zero-bytes wire identity
-    asserted directly by tests/test_aggtree.py."""
+    `agg_*` and master-shard `shard_*` fields on
+    GradientRequest/GradUpdate are the later extensions to existing
+    messages — pinned here so any further growth is a conscious edit,
+    with their unset-is-zero-bytes wire identity asserted directly by
+    tests/test_aggtree.py and tests/test_shardedps.py."""
     expect = {
         "GradientRequest": ["weights", "samples", "fit_token", "delta",
                            "step_version", "local_steps", "learning_rate",
                            "batch_size", "ef_rollback_version", "hedge",
                            "agg_parent", "agg_round", "agg_wait_ms",
-                           "agg_children"],
+                           "agg_children", "shard_index", "shard_count",
+                           "shard_lo", "shard_hi", "shard_round"],
         "GradUpdate": ["dense", "sparse", "n_steps", "compressed",
                        "stale_version", "agg_contributors",
-                       "agg_forwarded", "agg_partial", "agg_flat"],
+                       "agg_forwarded", "agg_partial", "agg_flat",
+                       "shard_index"],
         "ForwardRequest": ["samples", "weights", "want_margins"],
         "ForwardReply": ["predictions", "margins"],
         "StartAsyncRequest": ["weights", "samples", "batch_size",
