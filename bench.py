@@ -387,6 +387,16 @@ def main() -> None:
         from benches import bench_serve
 
         bench_serve.main(smoke="--smoke" in sys.argv)
+        # serving-plane HA gate (docs/SERVING.md "HA"): two LIVE routers
+        # peer-synced over SyncServeState front one replica fleet while a
+        # 4x load ramp runs through a failover client and the DECIDER
+        # router is killed mid-ramp — hard-asserting zero dropped
+        # requests, the p99 SLO, no promoted-version split brain beyond
+        # one sync interval, lease failover, post-failover promotion and
+        # exactly one post-failover canary rollback.
+        from benches import bench_serve_ha
+
+        bench_serve_ha.main(smoke="--smoke" in sys.argv)
         return
     if "--scale" in sys.argv:
         # master-plane scaling gate (docs/SCALING.md): rounds/s vs worker

@@ -131,6 +131,16 @@ def tolerance_for(name: str, timing_tolerance: float = DEFAULT_TOLERANCE,
     if ((series or "").startswith(("chaos", "soak"))
             and name.endswith(("_loss", "_acc"))):
         return timing_tolerance
+    # serve_ha (benches/bench_serve_ha.py): the HA scenario's p50/p99 ride
+    # a load ramp AND a mid-run decider-router kill on a shared CI box —
+    # the bench's own hard SLO assert is the latency gate.  The history
+    # series exists for the DETERMINISTIC rows (dropped-request count,
+    # split-brain window, failover/rollback counters, recorded as *_info)
+    # — timing noise must not block recording those, so the latency
+    # columns of this class report but never gate.
+    if ((series or "").startswith("serve_ha")
+            and name.endswith(("_p50_s", "_p99_s"))):
+        return float("inf")
     for suffixes, tol in CLASS_TOLERANCES:
         if name.endswith(suffixes):
             return tol

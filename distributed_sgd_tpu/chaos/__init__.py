@@ -133,6 +133,14 @@ SCENARIOS: Dict[str, str] = {
     # return together — the re-registration/resplit thundering herd
     "thundering-rejoin": "seed=59;drop=0.02;delay=1ms~10ms;"
                          "partition=w1:2s@3s,w2:2s@3s,w3:2s@3s",
+    # flapping decider router (docs/SERVING.md "HA"): repeated SHORT
+    # kills + restarts of the node named `router` under scope=named, each
+    # gap just past a typical HA lease TTL — the survivor assumes the
+    # decider lease, then the flapping router rejoins (and must adopt the
+    # survivor's newer promoted-state record, never resurrect its own),
+    # three times in a row, over mild transport jitter
+    "router-flap": "seed=71;scope=named;delay=1ms~8ms;"
+                   "partition=router:0.8s@2s,router:0.8s@5s,router:0.8s@8s",
 }
 
 

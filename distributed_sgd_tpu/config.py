@@ -304,6 +304,26 @@ class Config:
     # fossilizing on the rows it started with.  0 (default): the probe
     # set and baseline are fixed at fleet start, byte-identical behavior.
     serve_probe_refresh_s: float = 0.0
+    # serving-plane HA (serving/ha.py; docs/SERVING.md "HA"): peer LIVE
+    # router endpoints this router syncs promoted state with, as
+    # 'peers:<host:port,...>[;self=<host:port>][;sync=<dur>][;ttl=<dur>]
+    # [;lease=<path>]'.  One router holds the decider lease for promote/
+    # rollback verdicts; the others mirror every transition over the
+    # SyncServeState RPC within one sync interval and assume the lease if
+    # it lapses.  None (default): single-router plane, no sync RPC ever
+    # issued, byte-identical serving wire.
+    serve_ha: Optional[str] = None
+    # load-adaptive replica autoscale SLO in milliseconds (serving/ha.py
+    # ReplicaAutoscaler; fleet mode, role=serve + serve_replicas > 0):
+    # when the router's worst eligible-replica load signal (EWMA latency
+    # x in-flight) sits over this for consecutive ticks, a replica spins
+    # up through the warm boot path; sustained idle drains one.  0
+    # (default): fixed fleet size.
+    serve_slo_ms: float = 0.0
+    # autoscale fleet-size ceiling (floor is the boot size)
+    serve_scale_max: int = 8
+    # dead time after every autoscale action: hysteresis against flapping
+    serve_scale_cooldown_s: float = 5.0
 
     # -- continual-learning autopilot (autopilot/; docs/CONTINUAL.md) -------
     # All default-off: with DSGD_AUTOPILOT unset no autopilot thread
@@ -565,6 +585,35 @@ class Config:
                 "DSGD_SERVE_PROBE_REFRESH_S > 0 needs DSGD_SERVE_PROBE: "
                 "the refresh re-reads the probe file on its cadence "
                 "(docs/SERVING.md)")
+        # -- serving-plane HA + autoscale (docs/SERVING.md "HA") ------------
+        if self.serve_ha:
+            if self.role_override != "route":
+                raise ValueError(
+                    "DSGD_SERVE_HA is a router knob (DSGD_ROLE=route): "
+                    "peer promoted-state sync runs between LIVE routers")
+            # fail spec typos at construction, not on the first sync;
+            # grammar owned by serving.ha.parse_ha_spec
+            from distributed_sgd_tpu.serving.ha import parse_ha_spec
+
+            parse_ha_spec(self.serve_ha)
+        if self.serve_slo_ms < 0:
+            raise ValueError(
+                "DSGD_SERVE_SLO_MS must be >= 0 (0 = autoscale off)")
+        if (self.serve_slo_ms > 0 and self.role_override == "serve"
+                and self.serve_replicas < 1):
+            raise ValueError(
+                "DSGD_SERVE_SLO_MS needs the fleet mode "
+                "(DSGD_SERVE_REPLICAS >= 1): the autoscaler grows and "
+                "shrinks an in-process replica fleet")
+        if self.serve_scale_max < 1:
+            raise ValueError("DSGD_SERVE_SCALE_MAX must be >= 1")
+        if (self.serve_slo_ms > 0
+                and self.serve_scale_max < max(1, self.serve_replicas)):
+            raise ValueError(
+                "DSGD_SERVE_SCALE_MAX must be >= the boot fleet size "
+                "(DSGD_SERVE_REPLICAS): the boot size is the scale floor")
+        if self.serve_scale_cooldown_s < 0:
+            raise ValueError("DSGD_SERVE_SCALE_COOLDOWN_S must be >= 0")
         # -- continual-learning autopilot (docs/CONTINUAL.md) ---------------
         if self.autopilot_poll_s <= 0:
             raise ValueError("DSGD_AUTOPILOT_POLL_S must be > 0")
@@ -712,6 +761,12 @@ class Config:
             serve_state=_env("DSGD_SERVE_STATE", None, str),
             serve_probe_refresh_s=_env("DSGD_SERVE_PROBE_REFRESH_S",
                                        cls.serve_probe_refresh_s, float),
+            serve_ha=_env("DSGD_SERVE_HA", None, str),
+            serve_slo_ms=_env("DSGD_SERVE_SLO_MS", cls.serve_slo_ms, float),
+            serve_scale_max=_env("DSGD_SERVE_SCALE_MAX",
+                                 cls.serve_scale_max, int),
+            serve_scale_cooldown_s=_env("DSGD_SERVE_SCALE_COOLDOWN_S",
+                                        cls.serve_scale_cooldown_s, float),
             autopilot=_env("DSGD_AUTOPILOT", cls.autopilot, bool),
             autopilot_poll_s=_env("DSGD_AUTOPILOT_POLL_S",
                                   cls.autopilot_poll_s, float),

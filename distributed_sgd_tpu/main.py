@@ -742,6 +742,15 @@ def _run_role(cfg: Config, role: str) -> None:
             probe_source=probe_source,
             probe_source_refresh_s=source_refresh_s,
         ).start()
+        if cfg.serve_ha:
+            # DSGD_SERVE_HA: dual LIVE routers — attach the lease-based
+            # coordinator and start the promoted-state peer-sync loop
+            # (serving/ha.py, docs/SERVING.md "HA")
+            from distributed_sgd_tpu.serving.ha import HACoordinator
+
+            router.attach_ha(HACoordinator.from_spec(
+                cfg.serve_ha, metrics=metrics_mod.global_metrics()))
+            router._ha.start()
         log.info("routing on :%d over %s (canary=%g, hedge=%gms)",
                  router.bound_port, cfg.serve_targets, cfg.serve_canary,
                  cfg.serve_hedge_ms)
@@ -771,11 +780,32 @@ def _run_role(cfg: Config, role: str) -> None:
             probe_path=cfg.serve_probe,
             probe_refresh_s=cfg.serve_probe_refresh_s,
         ).start()
+        autoscaler = None
+        if cfg.serve_slo_ms > 0:
+            # DSGD_SERVE_SLO_MS: load-adaptive replica autoscale — the
+            # router's EWMA-latency x in-flight signal against the p99
+            # SLO, warm spin-up / drain with hysteresis + cooldown
+            # (serving/ha.py ReplicaAutoscaler, docs/SERVING.md)
+            from distributed_sgd_tpu.serving.ha import (
+                ReplicaAutoscaler,
+                router_load_ms,
+            )
+
+            autoscaler = ReplicaAutoscaler(
+                signal_ms=lambda: router_load_ms(fleet.router),
+                scale_up=fleet.add_replica, scale_down=fleet.drain_replica,
+                count=lambda: len(fleet.replicas), slo_ms=cfg.serve_slo_ms,
+                min_replicas=cfg.serve_replicas,
+                max_replicas=cfg.serve_scale_max,
+                cooldown_s=cfg.serve_scale_cooldown_s,
+                metrics=metrics_mod.global_metrics()).start()
         log.info("serving fleet: router :%d over %d in-process replicas",
                  fleet.router_port, cfg.serve_replicas)
         try:
             fleet.await_termination()
         finally:
+            if autoscaler is not None:
+                autoscaler.stop()
             fleet.stop()
         return
     if role == "serve":

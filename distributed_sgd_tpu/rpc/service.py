@@ -290,13 +290,19 @@ _SERVE_METHODS = {
     # versioned weight updates; an older replica answers UNIMPLEMENTED and
     # keeps hot-reloading from the checkpoint files instead
     "PushWeights": (pb.PushWeightsRequest, pb.PushWeightsReply),
+    # serving-plane HA peer sync (DSGD_SERVE_HA, docs/SERVING.md "HA"):
+    # dual LIVE routers exchange their versioned promoted-state records;
+    # an older binary (or a plain replica) answers UNIMPLEMENTED and the
+    # coordinator counts a missed sync instead of failing the router
+    "SyncServeState": (pb.SyncServeStateRequest, pb.SyncServeStateReply),
 }
 
 # Methods a servicer may legitimately lack (older binaries, partial test
 # stubs): absent -> no handler -> UNIMPLEMENTED to callers.  Everything
 # else is required and fails server construction when missing.
 _OPTIONAL_METHODS = frozenset(
-    {"Metrics", "PushWeights", "FitStream", "AggregateGrad"})
+    {"Metrics", "PushWeights", "FitStream", "AggregateGrad",
+     "SyncServeState"})
 
 
 def _traced_handler(fn, method: str, node: Optional[str]):

@@ -53,14 +53,19 @@ class ServingFleet:
     ):
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
+        # the recipe a warm spin-up reuses: add_replica() builds new
+        # ServingServers exactly like the boot-time ones (autoscale,
+        # serving/ha.py ReplicaAutoscaler)
+        self._replica_kw = dict(
+            checkpoint_dir=checkpoint_dir, model=model, lam=lam, port=0,
+            host=host, max_batch=max_batch, max_delay_ms=max_delay_ms,
+            queue_depth=queue_depth, ckpt_poll_s=ckpt_poll_s,
+            request_timeout_s=request_timeout_s,
+        )
+        self._host = host
+        self._started = False
         self.replicas: List[ServingServer] = [
-            ServingServer(
-                checkpoint_dir, model=model, lam=lam, port=0, host=host,
-                max_batch=max_batch, max_delay_ms=max_delay_ms,
-                queue_depth=queue_depth, ckpt_poll_s=ckpt_poll_s,
-                metrics=metrics_mod.Metrics(),
-                request_timeout_s=request_timeout_s,
-            )
+            ServingServer(metrics=metrics_mod.Metrics(), **self._replica_kw)
             for _ in range(n_replicas)
         ]
         self.router = ServingRouter(
@@ -86,9 +91,38 @@ class ServingFleet:
         log.warning("killing replica %d (:%d)", i, self.replicas[i].bound_port)
         self.replicas[i].stop()
 
+    # -- elastic membership (autoscale: serving/ha.py) -----------------------
+
+    def add_replica(self) -> ServingServer:
+        """Spin up one more replica through the warm boot path (same
+        compile cache, same checkpoint dir — it loads the latest file on
+        start) and join it to the router's pick pool pre-warmed with the
+        promoted weights."""
+        r = ServingServer(metrics=metrics_mod.Metrics(), **self._replica_kw)
+        if self._started:
+            r.start()
+        self.replicas.append(r)
+        self.router.add_replica(self._host, r.bound_port)
+        return r
+
+    def drain_replica(self) -> bool:
+        """Drain the newest replica back out (autoscale spin-down):
+        removed from the router's pick pool first, THEN stopped — any
+        racing call fails over, zero drops.  Refuses to go below one."""
+        if len(self.replicas) <= 1:
+            return False
+        r = self.replicas.pop()
+        self.router.remove_replica(f"{self._host}:{r.bound_port}")
+        try:
+            r.stop()
+        except Exception:  # noqa: BLE001 - already-dead replica drains twice
+            pass
+        return True
+
     def start(self) -> "ServingFleet":
         for r in self.replicas:
             r.start()
+        self._started = True
         self.router.start()
         log.info("serving fleet up: router :%d over %d replicas",
                  self.router_port, len(self.replicas))

@@ -155,6 +155,27 @@ class WeightPusher:
         self._prev = (version, w)
         return acked
 
+    def retarget(self, targets: Sequence[Tuple[str, int]]) -> None:
+        """Re-point the pusher at a new target set mid-stream (router
+        decider failover, serving/ha.py: the distributor re-targets its
+        pushes to the surviving LIVE routers).  Claims and channels of
+        KEPT targets survive — their delta chains stay unbroken; dropped
+        targets close, new ones start claimless (first contact is a full
+        send, as ever)."""
+        new = [(h, int(p)) for h, p in targets]
+        if not new:
+            raise ValueError("retarget needs at least one target")
+        for t in self._targets:
+            if t not in new:
+                self._channels.pop(t).close()
+                self._stubs.pop(t)
+                self._acked.pop(t, None)
+        for t in new:
+            if t not in self._channels:
+                self._channels[t] = new_channel(*t)
+                self._stubs[t] = ServeStub(self._channels[t])
+        self._targets = new
+
     def close(self) -> None:
         for ch in self._channels.values():
             ch.close()
@@ -213,6 +234,12 @@ class CheckpointDistributor:
                      step, acked, len(self.pusher._targets))
             self._last = step
             return True
+
+    def retarget(self, targets: Sequence[Tuple[str, int]]) -> None:
+        """Swap the fleet target set between polls (decider failover:
+        drop the dead router, keep pushing to the survivors)."""
+        with self._poll_lock:
+            self.pusher.retarget(targets)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_s):

@@ -218,7 +218,10 @@ class ServingRouter:
             target=self._health_loop, daemon=True, name="route-health")
 
         # -- canary state (all under _push_lock) ---------------------------
-        self._push_lock = threading.Lock()
+        # RLock: the HA coordinator's lease refresh can detect a failover
+        # while a PushWeights verdict (which holds this lock) asks
+        # is_decider(), and the assume-lease re-pin then re-enters
+        self._push_lock = threading.RLock()
         self.canary_fraction = float(canary_fraction)
         self.canary_ratio = float(canary_ratio)
         self._probe = list(probe) if probe else None
@@ -269,6 +272,19 @@ class ServingRouter:
         # fan-out) instead of re-canarying it, and an already-rejected
         # version stays rejected.  None (default): in-memory only.
         self._state_path = state_path
+        # serving-plane HA (serving/ha.py, DSGD_SERVE_HA): the sidecar is
+        # a VERSIONED record — `seq` numbers every promote/rollback/
+        # baseline transition monotonically, so two LIVE routers (and a
+        # rejoining one) can totally order their records and the higher
+        # seq wins every exchange.  Only the decider lease holder bumps
+        # seq (single-writer counter); the mirror's record advances by
+        # ADOPTING the decider's over SyncServeState.  _ha is the
+        # attached HACoordinator (None = HA off, nothing here runs);
+        # _ha_pending caches the weights of a push this router DEFERRED
+        # as non-decider, so the peer-synced promotion can pin them.
+        self._state_seq = 0
+        self._ha = None
+        self._ha_pending: Optional[Tuple[int, np.ndarray]] = None
         self._restore_state()
 
         self._server = new_server(port, host=host)
@@ -495,6 +511,7 @@ class ServingRouter:
                 self._probe_loss_hist.append(float(loss))
             self.metrics.counter(
                 metrics_mod.ROUTER_PROBE_REFRESH).increment()
+            self._state_transition()
             self._persist_state()
         log.info(
             "canary probe set refreshed (%d rows): baseline re-anchored to "
@@ -680,10 +697,22 @@ class ServingRouter:
             rejected = set(int(v) for v in state.get("rejected", []))
             best = state.get("best_loss")
             best = None if best is None else float(best)
+            seq = int(state.get("seq", 0))
         except (OSError, ValueError, TypeError, AttributeError) as e:
-            log.warning("router state %s unreadable (%s); starting fresh",
-                        self._state_path, e)
+            # quarantine, don't delete: the operator can inspect what a
+            # crashed/foreign writer left behind, and the rename also
+            # stops every subsequent restart from re-parsing (and
+            # re-warning about) the same bad bytes
+            quarantine = self._state_path + ".corrupt"
+            try:
+                os.replace(self._state_path, quarantine)
+            except OSError:
+                quarantine = "<quarantine failed>"
+            log.warning("router state %s unreadable (%s); quarantined to "
+                        "%s and starting fresh", self._state_path, e,
+                        quarantine)
             return
+        self._state_seq = seq
         if promoted is not None:
             self._promoted_version = promoted
         self._rejected = rejected
@@ -712,6 +741,7 @@ class ServingRouter:
             return
         best = self._checker.best_loss
         state = {
+            "seq": self._state_seq,
             "promoted_version": self._promoted_version,
             "best_loss": best if best != float("inf") else None,
             "rejected": sorted(self._rejected),
@@ -728,6 +758,17 @@ class ServingRouter:
             log.warning("router state write to %s failed: %s",
                         self._state_path, e)
 
+    def _state_transition(self) -> None:
+        """Under _push_lock, on every promote/rollback/baseline change:
+        advance the versioned record's seq and wake the HA sync loop so
+        the peer mirrors the transition NOW, not a sync interval later.
+        Only the decider bumps — a mirror's local edits (e.g. its own
+        probe refresh) must not outrank the decider's verdicts."""
+        if self._ha is None or self._ha.is_decider():
+            self._state_seq += 1
+        if self._ha is not None:
+            self._ha.notify()
+
     def _promote(self, version: int, w: np.ndarray,
                  loss: Optional[float]) -> None:
         self._promoted_version = int(version)
@@ -736,6 +777,7 @@ class ServingRouter:
             self._checker.check(loss, 0.0, self._w_promoted, step=version)
             self.metrics.gauge(metrics_mod.ROUTER_CANARY_LOSS).set(loss)
         self.metrics.counter(metrics_mod.ROUTER_CANARY_PROMOTED).increment()
+        self._state_transition()
         self._persist_state()
         log.info("version %d promoted fleet-wide (probe loss %s)",
                  version, f"{loss:.6f}" if loss is not None else "n/a")
@@ -760,6 +802,7 @@ class ServingRouter:
     def _rollback(self, version: int, canaries: Sequence["_Replica"],
                   loss: float) -> None:
         self._rejected.add(int(version))
+        self._state_transition()
         self._persist_state()
         self.metrics.counter(metrics_mod.ROUTER_CANARY_ROLLBACK).increment()
         flight.record("router.canary.rollback", version=int(version),
@@ -785,6 +828,23 @@ class ServingRouter:
             w_new = self._resolve_weights(request)
             if w_new is None:
                 self.metrics.counter(metrics_mod.SERVE_PUSH_NACK).increment()
+                return pb.PushWeightsReply(ok=False, model_step=current)
+            if self._ha is not None and not self._ha.is_decider():
+                # non-decider LIVE router (DSGD_SERVE_HA): promote/
+                # rollback/canary verdicts belong to the lease holder —
+                # two routers fronting the same replicas must not both
+                # canary the same version.  The promoted version's
+                # re-stream just refreshes the weight cache (the
+                # post-failover re-pin needs it); anything newer is
+                # DEFERRED: cache the weights and NACK, and the verdict
+                # arrives over SyncServeState within one sync interval.
+                w_new = np.asarray(w_new, np.float32)
+                if version == self._promoted_version:
+                    self._w_promoted = w_new
+                    return pb.PushWeightsReply(ok=True, model_step=version)
+                self._ha_pending = (version, w_new)
+                self.metrics.counter(
+                    metrics_mod.ROUTER_HA_DEFERRED).increment()
                 return pb.PushWeightsReply(ok=False, model_step=current)
             # reply `ok` is the ROUTER's accept/reject decision ONLY
             # (promoted vs canary-rejected/version-gap) — NOT fan-out
@@ -850,6 +910,141 @@ class ServingRouter:
                             version, acked, len(self._replicas))
             return pb.PushWeightsReply(ok=True, model_step=version)
 
+    # -- serving-plane HA (serving/ha.py, DSGD_SERVE_HA) ---------------------
+
+    def attach_ha(self, coordinator) -> "ServingRouter":
+        """Wire an HACoordinator onto a constructed router (the
+        coordinator derives its node label from the bound port, so this
+        runs post-construction).  The caller start()s the coordinator;
+        stop() here tears it down with the router."""
+        self._ha = coordinator
+        coordinator.attach(self)
+        return self
+
+    def export_ha_state(self) -> dict:
+        """The versioned promoted-state record the sync loop ships:
+        {seq, promoted, best, rejected}."""
+        with self._push_lock:
+            best = self._checker.best_loss
+            return {
+                "seq": self._state_seq,
+                "promoted": self._promoted_version,
+                "best": None if best == float("inf") else best,
+                "rejected": sorted(self._rejected),
+            }
+
+    def _apply_ha_locked(self, record) -> bool:
+        """Adopt a peer's record iff it is STRICTLY newer (higher seq) —
+        the no-resurrection rule: a rollback outranks the promote it
+        reverted, so a rejoining router replaying a stale promote can
+        never resurrect the rolled-back version.  Called under
+        _push_lock from the RPC handler and the sync loop."""
+        if self._ha is None or int(record.seq) <= self._state_seq:
+            return False
+        self._state_seq = int(record.seq)
+        promoted = (int(record.promoted_version) if record.has_promoted
+                    else None)
+        if promoted != self._promoted_version:
+            self._promoted_version = promoted
+            # the record carries no weights: pin the deferred-push cache
+            # if it matches, else the cache empties and the promoted
+            # version's next re-stream (or the gap fallback) refills it
+            self._w_promoted = None
+        self._rejected = set(int(v) for v in record.rejected)
+        self._checker.best_loss = (float(record.best_loss)
+                                   if record.has_best else float("inf"))
+        if self._ha_pending is not None:
+            pv, pw = self._ha_pending
+            if promoted == pv:
+                self._w_promoted = pw
+                self._ha_pending = None
+            elif pv in self._rejected:
+                self._ha_pending = None
+        self._persist_state()
+        self.metrics.counter(metrics_mod.ROUTER_HA_APPLIED).increment()
+        log.info("HA record seq %d adopted from peer: promoted=%s, "
+                 "%d rejected", self._state_seq, promoted,
+                 len(self._rejected))
+        return True
+
+    def apply_ha_record(self, record) -> bool:
+        with self._push_lock:
+            return self._apply_ha_locked(record)
+
+    def SyncServeState(self, request, context):  # noqa: N802 - gRPC method name
+        """Peer routers exchange versioned promoted-state records; both
+        directions carry the FULL record, so one exchange converges the
+        pair no matter which side is stale.  With HA off this router
+        adopts nothing (applied=False) but still answers with its local
+        record — a misconfigured peer learns our state instead of
+        getting a hang."""
+        if self._ha is not None and request.node:
+            self._ha.observe_peer(str(request.node))
+        with self._push_lock:
+            applied = (self._apply_ha_locked(request)
+                       if self._ha is not None else False)
+            reply = pb.SyncServeStateReply(applied=applied,
+                                           seq=self._state_seq)
+            if self._promoted_version is not None:
+                reply.has_promoted = True
+                reply.promoted_version = int(self._promoted_version)
+            best = self._checker.best_loss
+            if best != float("inf"):
+                reply.has_best = True
+                reply.best_loss = float(best)
+            reply.rejected.extend(sorted(self._rejected))
+        if self._ha is not None:
+            self.metrics.counter(metrics_mod.ROUTER_HA_SYNCS).increment()
+        return reply
+
+    def _on_assume_lease(self) -> None:
+        """The decider lease lapsed onto this router: re-pin the mirrored
+        promoted state fleet-wide so every replica serves the survivor's
+        truth, whatever the dead decider was midway through.  The seq is
+        NOT bumped — assuming the lease is not a state transition, and a
+        rejoining ex-decider whose record is genuinely newer (it finished
+        a verdict before dying) must still win the next exchange."""
+        with self._push_lock:
+            if self._promoted_version is None:
+                return
+            self._repin(self._replicas)
+
+    # -- fleet membership (autoscale: serving/ha.py ReplicaAutoscaler) -------
+
+    def add_replica(self, host: str, port: int) -> "_Replica":
+        """Join a replica to the live fleet (autoscale spin-up / operator
+        add).  It is warmed with the cached promoted weights (full push)
+        so it serves the fleet's version from its first health pass
+        instead of waiting out the next checkpoint."""
+        r = _Replica(host, int(port), self._policy)
+        with self._push_lock:
+            if self._w_promoted is not None:
+                req = pb.PushWeightsRequest(version=self._promoted_version)
+                req.weights.CopyFrom(codec.encode_tensor(self._w_promoted))
+                self._fan_out(req, [r])
+            self._replicas.append(r)
+        log.info("replica %s joined the fleet (%d total)", r.endpoint,
+                 len(self._replicas))
+        return r
+
+    def remove_replica(self, endpoint: str) -> bool:
+        """Drain a replica out of the fleet (autoscale spin-down): it
+        leaves the pick pool immediately, and any call racing the channel
+        close fails over exactly like a died replica — zero drops."""
+        with self._push_lock:
+            victims = [r for r in self._replicas if r.endpoint == endpoint]
+            if not victims:
+                return False
+            if len(self._replicas) - len(victims) < 1:
+                raise ValueError("cannot drain the last replica")
+            self._replicas = [r for r in self._replicas
+                              if r.endpoint != endpoint]
+        for r in victims:
+            r.close()
+        log.info("replica %s drained from the fleet (%d left)", endpoint,
+                 len(self._replicas))
+        return True
+
     # -- fleet health + telemetry -------------------------------------------
 
     def ServeHealth(self, request, context):  # noqa: N802 - gRPC method name
@@ -891,6 +1086,8 @@ class ServingRouter:
 
     def stop(self, grace: float = 1.0) -> None:
         self._stop.set()
+        if self._ha is not None:
+            self._ha.stop()
         self._server.stop(grace).wait()
         if self._health_thread.is_alive():
             self._health_thread.join(timeout=self.health_s + 1.0)

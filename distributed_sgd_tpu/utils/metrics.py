@@ -502,6 +502,18 @@ ROUTER_PROBE_REFRESH = "router.canary.probe_refresh"  # counter: probe-set rotat
 ROUTER_PROBE_SOURCED = "router.canary.probe_sourced"  # counter: reservoir rotations
 ROUTER_PROBE_FILL = "router.canary.probe_fill"        # gauge: reservoir rows held
 
+# serving-plane HA + autoscale (serving/ha.py; docs/SERVING.md "HA")
+ROUTER_HA_DECIDER = "router.ha.decider"              # gauge: 1 = holds the decider lease
+ROUTER_HA_SYNCS = "router.ha.syncs"                  # counter: peer state syncs delivered
+ROUTER_HA_SYNC_ERRORS = "router.ha.sync_errors"      # counter: peer syncs that failed
+ROUTER_HA_APPLIED = "router.ha.applied"              # counter: peer records adopted locally
+ROUTER_HA_DEFERRED = "router.ha.deferred"            # counter: pushes deferred (not decider)
+ROUTER_HA_FAILOVERS = "router.ha.failovers"          # counter: lease assumed after a lapse
+ROUTER_SCALE_UP = "router.scale.up"                  # counter: replicas spun up
+ROUTER_SCALE_DOWN = "router.scale.down"              # counter: replicas drained off
+ROUTER_SCALE_REPLICAS = "router.scale.replicas"      # gauge: current fleet size
+ROUTER_SCALE_LOAD_MS = "router.scale.load_ms"        # gauge: last load signal read
+
 
 def record_push(metrics: "Metrics", form: str, wire_bytes: int,
                 dense_bytes: int) -> None:

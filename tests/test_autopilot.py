@@ -413,3 +413,66 @@ def test_autopilot_env_knobs_parse(monkeypatch):
     assert cfg.autopilot_recovery_band == 1.5
     assert cfg.autopilot_probe_capacity == 48
     assert cfg.autopilot_label_delay == 4
+
+
+# -- model-zoo recurrence (serving-plane HA PR satellite) ---------------------
+
+
+def test_zoo_recurrence_rejected_id_stays_rejected_fresh_id_promotes(tmp_path):
+    """Seasonality (DriftingStream schedule='recurring') meets the canary
+    gate's rejection-by-version-id rule.  A 'model zoo' keeps one trained
+    model per concept; when a concept RECURS, re-pushing the exact version
+    id that was rejected during the previous occurrence stays rejected
+    (rejection is a verdict on an id, and probe rotation must not re-open
+    it) — but a FRESH id carrying the SAME zoo weights flows through the
+    re-anchored canary gate and promotes, so the zoo stays usable."""
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    # two concepts, two zoo models: concept B is concept A's label flip,
+    # exactly the recurring stream's phase-1 world (labels move, features
+    # don't) — each model scores ~0 on its own concept, ~2 on the other
+    rec = DriftingStream(n_features=64, nnz=4, seed=3,
+                         schedule="recurring", period_rows=100)
+    assert rec.phase(50) == 0.0 and rec.phase(150) == 1.0
+    assert rec.phase(250) == 0.0  # the season comes back
+    rng = np.random.default_rng(11)
+    w_zoo_a = rng.normal(size=64).astype(np.float32)
+    w_zoo_a[w_zoo_a == 0] = 0.1
+    w_zoo_b = -w_zoo_a
+
+    def probe_for(w):
+        return [(np.array([i], np.int32), np.array([1.0], np.float32),
+                 float(-np.sign(w[i]) or 1.0)) for i in range(8)]
+
+    ck = Checkpointer(str(tmp_path))
+    ck.save(1, w_zoo_a)
+    ck.close()
+    m = Metrics()
+    with ServingFleet(str(tmp_path), n_replicas=2, ckpt_poll_s=60.0,
+                      health_s=0.2, canary_fraction=0.5,
+                      probe=probe_for(w_zoo_a), metrics=m) as f:
+        pusher = WeightPusher([("127.0.0.1", f.router_port)],
+                              metrics=Metrics())
+        # concept A's season: the A model promotes, anchoring the baseline
+        assert pusher.push(2, w_zoo_a) == 1
+        # first occurrence of concept B: the zoo's B model pushed as v3
+        # against the A-anchored probe — rolled back, id 3 rejected
+        assert pusher.push(3, w_zoo_b) == 0
+        assert m.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1
+
+        # the concept SHIFTS for real and stays: the probe rotates to
+        # B-concept rows and the gate re-anchors on them
+        f.router.refresh_probe(probe_for(w_zoo_b))
+        # recurrence: replaying the rejected id is NACKed outright — no
+        # canary probe burned, no resurrection via probe rotation
+        assert pusher.push(3, w_zoo_b) == 0
+        assert m.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1
+        # but a FRESH id of the same zoo model is promotable now
+        assert pusher.push(4, w_zoo_b) == 1
+        assert m.counter(mm.ROUTER_CANARY_PROMOTED).value >= 2
+        for r in f.replicas:
+            np.testing.assert_array_equal(np.asarray(r.store.get()[1]),
+                                          w_zoo_b)
+        pusher.close()

@@ -240,6 +240,17 @@ def test_scenario_library_resolves_by_name():
     p = parse_plan(chaos.resolve_scenario("scenario:thundering-rejoin"))
     assert len(p.partitions) == 3  # the correlated blip
     assert len({(q.at_s, q.dur_s) for q in p.partitions}) == 1
+    p = parse_plan(chaos.resolve_scenario("scenario:router-flap"))
+    # repeated short decider kills, confined to the named router plane:
+    # every window hits the SAME node, windows are short and disjoint
+    assert p.scope == "named"
+    assert {q.name for q in p.partitions} == {"router"}
+    assert len(p.partitions) == 3
+    assert all(q.dur_s < 1.0 for q in p.partitions)
+    starts = sorted(q.at_s for q in p.partitions)
+    ends = [s + q.dur_s for s, q in zip(starts, sorted(
+        p.partitions, key=lambda q: q.at_s))]
+    assert all(e < s2 for e, s2 in zip(ends, starts[1:]))  # flaps, not one outage
     with pytest.raises(ValueError, match="unknown chaos scenario"):
         chaos.resolve_scenario("scenario:meteor-strike")
     assert chaos.resolve_scenario("seed=1;drop=0.5") == "seed=1;drop=0.5"

@@ -588,6 +588,40 @@ def test_malformed_state_sidecar_starts_fresh(tmp_path):
     r.stop(grace=0.1)
 
 
+@pytest.mark.parametrize("bad", [
+    "",                                     # empty (crashed mid-create)
+    '{"promoted_version": 4, "reje',        # truncated (torn write)
+    '[1, 2, 3]',                            # wrong schema (foreign writer)
+    '{"promoted_version": 4, "best_loss": "high", "rejected": [5]}',
+], ids=["empty", "truncated", "wrong-schema", "garbage-values"])
+def test_corrupt_state_sidecar_quarantined_and_starts_fresh(tmp_path, bad):
+    """Every corruption class starts the router fresh AND quarantines the
+    bad bytes as <path>.corrupt — the operator can inspect what the
+    crashed/foreign writer left, and the next restart does not re-parse
+    (or re-warn about) the same file."""
+    import os
+
+    from distributed_sgd_tpu.serving.router import ServingRouter
+
+    state = tmp_path / "state.json"
+    state.write_text(bad)
+    r = ServingRouter([("127.0.0.1", 1)], metrics=Metrics(),
+                      state_path=str(state))
+    assert r._promoted_version is None and r._rejected == set()
+    assert r._checker.best_loss == float("inf")
+    assert not os.path.exists(str(state))  # moved aside, not re-parsed
+    assert (tmp_path / "state.json.corrupt").read_text() == bad
+    r.stop(grace=0.1)
+
+    # the quarantined bytes survive the next lifecycle: a second boot
+    # starts clean without touching the .corrupt file
+    r2 = ServingRouter([("127.0.0.1", 1)], metrics=Metrics(),
+                       state_path=str(state))
+    assert r2._promoted_version is None
+    assert (tmp_path / "state.json.corrupt").read_text() == bad
+    r2.stop(grace=0.1)
+
+
 def test_canary_survives_a_dead_first_replica(tmp_path):
     """Canaries are drawn from the ELIGIBLE set: killing the replica that
     static indexing would pick as THE canary must not freeze fleet

@@ -1,0 +1,677 @@
+"""Serving-plane HA (serving/ha.py; docs/SERVING.md "HA" / "Autoscale"):
+the DSGD_SERVE_HA spec grammar, both decider-lease backends, the
+SyncServeState exchange (promote/rollback mirrored within one sync pass,
+deferred-push weight pinning, rejoin convergence and the no-resurrection
+rule), the client-side failover stub, the load-adaptive replica
+autoscaler's hysteresis/cooldown/clamps, live fleet membership, the
+proto-surface pin for the SyncServeState family, and the knobs-off
+guarantee — with DSGD_SERVE_HA unset no SyncServeState RPC is ever
+issued and the serving plane behaves byte-identically."""
+
+import json
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from distributed_sgd_tpu.rpc import dsgd_pb2 as pb
+from distributed_sgd_tpu.rpc.service import ServeStub, new_channel
+from distributed_sgd_tpu.utils import metrics as mm
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+
+def _save(path, step, w):
+    from distributed_sgd_tpu.checkpoint import Checkpointer
+
+    ck = Checkpointer(str(path))
+    ck.save(step, w)
+    ck.close()
+
+
+def _probe_rows(w, n=8):
+    """Single-coordinate probe rows labeled so `w` scores ZERO hinge loss
+    and sign-flipped weights score ~2.0 (the test_router.py fixture)."""
+    rows = []
+    for i in range(n):
+        rows.append((np.array([i], np.int32), np.array([1.0], np.float32),
+                     float(-np.sign(w[i]) or 1.0)))
+    return rows
+
+
+# -- the DSGD_SERVE_HA spec grammar ------------------------------------------
+
+
+def test_parse_ha_spec_grammar_and_errors():
+    from distributed_sgd_tpu.serving.ha import parse_ha_spec
+
+    out = parse_ha_spec("peers:10.0.0.2:4100,10.0.0.3:4100")
+    assert out["peers"] == ["10.0.0.2:4100", "10.0.0.3:4100"]
+    assert out["node"] is None  # defaults to the bound port at attach
+    assert out["sync_s"] == 0.25 and out["lease_ttl_s"] is None
+    assert out["lease_path"] is None
+
+    out = parse_ha_spec("peers:h2:1;self=h1:1;sync=100ms;ttl=2s;lease=/l")
+    assert out == {"peers": ["h2:1"], "node": "h1:1", "sync_s": 0.1,
+                   "lease_ttl_s": 2.0, "lease_path": "/l"}
+
+    with pytest.raises(ValueError, match="peers:"):
+        parse_ha_spec("10.0.0.2:4100")
+    with pytest.raises(ValueError, match="unknown DSGD_SERVE_HA key"):
+        parse_ha_spec("peers:h:1;synk=1s")
+    with pytest.raises(ValueError, match="key=value"):
+        parse_ha_spec("peers:h:1;fast")
+    with pytest.raises(ValueError, match="sync cadence"):
+        parse_ha_spec("peers:h:1;sync=0")
+    with pytest.raises(ValueError, match="lease ttl"):
+        parse_ha_spec("peers:h:1;ttl=-1s")
+
+
+# -- decider leases ----------------------------------------------------------
+
+
+def test_file_lease_acquire_renew_expire_takeover(tmp_path):
+    from distributed_sgd_tpu.serving.ha import FileLease
+
+    t = [0.0]
+    path = str(tmp_path / "lease.json")
+    a = FileLease(path, "a", ttl_s=1.0, clock=lambda: t[0])
+    b = FileLease(path, "b", ttl_s=1.0, clock=lambda: t[0])
+    assert a.acquire()          # absent: claimable
+    assert not b.acquire()      # live foreign holder: defer
+    assert b.holder() == "a"
+    t[0] = 0.5
+    assert a.acquire()          # renewal pushes the expiry out
+    t[0] = 1.2                  # past the ORIGINAL expiry, not the renewed
+    assert not b.acquire()
+    t[0] = 2.0                  # the renewed lease (expiry 1.5) lapsed
+    assert b.acquire()
+    assert b.term == 1          # takeover opens a new term
+    assert not a.acquire()      # the old holder defers to the new one
+    b.release()
+    assert b.holder() is None
+    assert a.acquire()
+
+
+def test_file_lease_corrupt_record_is_claimable(tmp_path):
+    from distributed_sgd_tpu.serving.ha import FileLease
+
+    path = tmp_path / "lease.json"
+    path.write_text('{"holder": "a", "expi')  # torn write
+    lease = FileLease(str(path), "b", ttl_s=1.0, clock=lambda: 0.0)
+    assert lease.holder() is None
+    assert lease.acquire()
+
+
+def test_peer_lease_rank_boot_presumption_and_lapse():
+    from distributed_sgd_tpu.serving.ha import PeerLease
+
+    t = [0.0]
+    low = PeerLease("h:1", ["h:2"], ttl_s=1.0, clock=lambda: t[0])
+    high = PeerLease("h:2", ["h:1"], ttl_s=1.0, clock=lambda: t[0])
+    # peers are presumed alive at boot: the LOW-ranked endpoint decides
+    # from the start and the other defers — no boot split-brain window
+    assert low.acquire() and not high.acquire()
+    assert high.holder() == "h:1"
+    t[0] = 1.5  # no observe() within one TTL: the low peer lapsed
+    assert high.acquire()
+    high.observe("h:1")  # the peer is back (a sync exchange answered)
+    assert not high.acquire()
+    # numeric port order, not string order: 'h:9' outranks 'h:10'... no,
+    # 9 < 10 numerically even though "9" > "10" lexically
+    nine = PeerLease("h:9", ["h:10"], ttl_s=1.0, clock=lambda: t[0])
+    nine.observe("h:10")
+    assert nine.acquire()
+
+
+def test_coordinator_validation():
+    from distributed_sgd_tpu.serving.ha import HACoordinator
+
+    with pytest.raises(ValueError, match="peer"):
+        HACoordinator([])
+    with pytest.raises(ValueError, match="sync_s"):
+        HACoordinator(["h:1"], sync_s=0.0)
+    with pytest.raises(RuntimeError, match="attach"):
+        HACoordinator(["h:1"]).start()
+    # ttl defaults to 4x the sync cadence
+    assert HACoordinator(["h:1"], sync_s=0.5).lease_ttl_s == 2.0
+
+
+# -- the dual-LIVE-router exchange -------------------------------------------
+
+
+@pytest.fixture
+def ha_pair(tmp_path):
+    """Two LIVE routers over one shared 2-replica fleet, coordinators
+    attached but NOT started — every exchange is driven synchronously via
+    sync_once() so verdict ordering is deterministic.  Long sync/ttl keep
+    the peer lease from lapsing mid-test."""
+    from distributed_sgd_tpu.serving.ha import HACoordinator
+    from distributed_sgd_tpu.serving.router import ServingRouter
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=64).astype(np.float32)
+    w[w == 0] = 0.1
+    _save(tmp_path / "ckpt", 1, w)
+    replicas = [ServingServer(str(tmp_path / "ckpt"), port=0,
+                              host="127.0.0.1", ckpt_poll_s=60.0,
+                              metrics=Metrics()).start()
+                for _ in range(2)]
+    endpoints = [("127.0.0.1", r.bound_port) for r in replicas]
+    probe = _probe_rows(w)
+
+    def mk(state_path=None):
+        return ServingRouter(
+            endpoints, port=0, host="127.0.0.1", canary_fraction=0.5,
+            probe=probe, health_s=0.2, request_timeout_s=5.0,
+            metrics=Metrics(), state_path=state_path).start()
+
+    ra, rb = mk(str(tmp_path / "a.json")), mk(str(tmp_path / "b.json"))
+    ca = HACoordinator([f"127.0.0.1:{rb.bound_port}"], sync_s=60.0,
+                       lease_ttl_s=600.0)
+    cb = HACoordinator([f"127.0.0.1:{ra.bound_port}"], sync_s=60.0,
+                       lease_ttl_s=600.0)
+    ra.attach_ha(ca)
+    rb.attach_ha(cb)
+    assert ca.is_decider() != cb.is_decider(), "exactly one decider"
+    if ca.is_decider():
+        decider, mirror, cd, cm = ra, rb, ca, cb
+    else:
+        decider, mirror, cd, cm = rb, ra, cb, ca
+    extra = []
+    try:
+        yield dict(decider=decider, mirror=mirror, cd=cd, cm=cm, w=w,
+                   endpoints=endpoints, probe=probe, tmp=tmp_path,
+                   mk=mk, extra=extra, replicas=replicas)
+    finally:
+        for r in extra + [ra, rb]:
+            r.stop(grace=0.1)
+        for r in replicas:
+            r.stop()
+
+
+def test_sync_mirrors_promote_defer_and_rollback(ha_pair):
+    """The whole verdict protocol, one exchange at a time: promote
+    mirrored, a mirror-side push deferred (NACK + weight cache), the
+    deferred weights pinned when the verdict arrives, rollback mirrored,
+    and a direct re-push of the rejected version NACKed by the mirror
+    without burning a canary — the no-resurrection rule at the mirror."""
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    p = ha_pair
+    decider, mirror, cd = p["decider"], p["mirror"], p["cd"]
+    pusher = WeightPusher([("127.0.0.1", decider.bound_port)],
+                          metrics=Metrics())
+    w2 = p["w"].copy()
+    w2[0] *= 1.001
+    assert pusher.push(2, w2) == 1
+    assert decider.promoted_version == 2
+    assert mirror.promoted_version is None  # exchange not driven yet
+    assert cd.sync_once() == 1
+    assert mirror.promoted_version == 2
+    assert mirror.metrics.counter(mm.ROUTER_HA_APPLIED).value == 1
+    # the baseline travels with the record: the mirror can gate the next
+    # version the moment it becomes the decider
+    assert (mirror._checker.best_loss == decider._checker.best_loss
+            != float("inf"))
+    # the sidecar carries the record's seq: monotone, promote bumped it
+    assert json.load(open(decider._state_path))["seq"] == decider._state_seq
+    seq_after_promote = decider._state_seq
+
+    # a NEW version pushed at the MIRROR is deferred: NACK, weights cached
+    w3 = p["w"].copy()
+    w3[1] *= 1.001
+    mpush = WeightPusher([("127.0.0.1", mirror.bound_port)],
+                         metrics=Metrics())
+    assert mpush.push(3, w3) == 0
+    assert mirror.metrics.counter(mm.ROUTER_HA_DEFERRED).value == 1
+    assert mirror._ha_pending is not None
+    assert decider.promoted_version == 2  # verdicts never flow mirror->up
+
+    # the decider promotes v3; the next exchange pins the cached weights
+    assert pusher.push(3, w3) == 1
+    assert cd.sync_once() == 1
+    assert mirror.promoted_version == 3
+    np.testing.assert_array_equal(mirror._w_promoted, w3)
+    assert mirror._ha_pending is None
+
+    # poison rolls back on the decider; the mirror adopts the rejection
+    assert pusher.push(4, -5.0 * p["w"]) == 0
+    assert decider.metrics.counter(mm.ROUTER_CANARY_ROLLBACK).value == 1
+    assert decider._state_seq > seq_after_promote
+    assert cd.sync_once() == 1
+    assert mirror._rejected == {4}
+    assert mirror.promoted_version == 3
+    # rejected stays rejected at the mirror: NACKed outright, no canary
+    assert mpush.push(4, -5.0 * p["w"]) == 0
+    assert mirror.metrics.counter(mm.ROUTER_CANARY_ROLLBACK).value == 0
+    pusher.close()
+    mpush.close()
+
+
+def test_rejoining_router_converges_and_cannot_resurrect(ha_pair):
+    """The acceptance scenario: a router killed mid-promote rejoins
+    believing a since-rolled-back version is promoted (stale sidecar,
+    LOWER seq).  One sync exchange converges it to the peer's record —
+    reply adoption — and the rolled-back version can never be served
+    again from either side."""
+    from distributed_sgd_tpu.serving.ha import HACoordinator
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    p = ha_pair
+    decider, cd = p["decider"], p["cd"]
+    pusher = WeightPusher([("127.0.0.1", decider.bound_port)],
+                          metrics=Metrics())
+    w2 = p["w"].copy()
+    w2[0] *= 1.001
+    assert pusher.push(2, w2) == 1           # seq 1: promote
+    assert pusher.push(3, -5.0 * p["w"]) == 0  # seq 2: rollback
+    assert cd.sync_once() == 1
+    pusher.close()
+
+    # the rejoiner died between its own v3 promote and the rollback: its
+    # sidecar claims v3 promoted at a seq the rollback has since outrun
+    stale = p["tmp"] / "c.json"
+    stale.write_text(json.dumps(
+        {"seq": 1, "promoted_version": 3, "best_loss": 0.5,
+         "rejected": []}))
+    rc = p["mk"](state_path=str(stale))
+    p["extra"].append(rc)
+    assert rc.promoted_version == 3  # boots believing the stale record
+    cc = HACoordinator([f"127.0.0.1:{decider.bound_port}"], sync_s=60.0,
+                       lease_ttl_s=600.0)
+    rc.attach_ha(cc)
+    assert cc.sync_once() == 1
+    # ONE exchange: the peer's reply carried the newer record and the
+    # rejoiner adopted it — promoted back to 2, 3 rejected, seq caught up
+    assert rc.promoted_version == 2
+    assert rc._rejected == {3}
+    assert rc._state_seq == decider._state_seq
+    assert json.load(open(str(stale)))["rejected"] == [3]
+    # ...and the decider did NOT adopt the stale claim
+    assert decider.promoted_version == 2 and decider._rejected == {3}
+    # the resurrection attempt: re-pushing v3 at the rejoiner is NACKed
+    cpush = WeightPusher([("127.0.0.1", rc.bound_port)], metrics=Metrics())
+    assert cpush.push(3, -5.0 * p["w"]) == 0
+    assert rc.metrics.counter(mm.ROUTER_CANARY_ROLLBACK).value == 0
+    cpush.close()
+    cc.stop()
+
+
+def test_lease_lapse_fails_over_to_survivor(tmp_path):
+    """Kill the decider under a REAL (started) coordinator pair with a
+    short TTL: the survivor assumes the lease, counts the failover, and
+    its own pushes promote from then on."""
+    from distributed_sgd_tpu.serving.ha import HACoordinator
+    from distributed_sgd_tpu.serving.push import WeightPusher
+    from distributed_sgd_tpu.serving.router import ServingRouter
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    rng = np.random.default_rng(9)
+    w = rng.normal(size=64).astype(np.float32)
+    w[w == 0] = 0.1
+    _save(tmp_path, 1, w)
+    replica = ServingServer(str(tmp_path), port=0, host="127.0.0.1",
+                            ckpt_poll_s=60.0, metrics=Metrics()).start()
+
+    def mk():
+        return ServingRouter(
+            [("127.0.0.1", replica.bound_port)], port=0, host="127.0.0.1",
+            probe=_probe_rows(w), health_s=0.2, request_timeout_s=5.0,
+            metrics=Metrics()).start()
+
+    ra, rb = mk(), mk()
+    ca = HACoordinator([f"127.0.0.1:{rb.bound_port}"], sync_s=0.1,
+                       lease_ttl_s=0.5)
+    cb = HACoordinator([f"127.0.0.1:{ra.bound_port}"], sync_s=0.1,
+                       lease_ttl_s=0.5)
+    ra.attach_ha(ca)
+    rb.attach_ha(cb)
+    ca.start()
+    cb.start()
+    try:
+        decider, survivor, cs = ((ra, rb, cb) if ca.is_decider()
+                                 else (rb, ra, ca))
+        pusher = WeightPusher([("127.0.0.1", decider.bound_port)],
+                              metrics=Metrics())
+        w2 = w.copy()
+        w2[0] *= 1.001
+        assert pusher.push(2, w2) == 1
+        pusher.close()
+        deadline = time.time() + 5
+        while time.time() < deadline and survivor.promoted_version != 2:
+            time.sleep(0.05)
+        assert survivor.promoted_version == 2  # mirrored by the loop
+        decider.stop(grace=0.1)
+        deadline = time.time() + 15
+        while time.time() < deadline and not cs.is_decider():
+            time.sleep(0.05)
+        assert cs.is_decider(), "survivor never assumed the lease"
+        assert survivor.metrics.counter(mm.ROUTER_HA_FAILOVERS).value == 1
+        spush = WeightPusher([("127.0.0.1", survivor.bound_port)],
+                             metrics=Metrics())
+        w3 = w.copy()
+        w3[1] *= 1.001
+        assert spush.push(3, w3) == 1  # the survivor DECIDES now
+        assert survivor.promoted_version == 3
+        spush.close()
+    finally:
+        for r in (ra, rb):
+            r.stop(grace=0.1)
+        replica.stop()
+
+
+# -- client-side failover ----------------------------------------------------
+
+
+def test_failover_client_sticks_with_the_router_that_answers(tmp_path):
+    from distributed_sgd_tpu.serving.ha import FailoverServeClient
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    w = np.arange(1, 9, dtype=np.float32)
+    _save(tmp_path, 1, w)
+    replica = ServingServer(str(tmp_path), port=0, host="127.0.0.1",
+                            ckpt_poll_s=60.0, metrics=Metrics()).start()
+    # a dead primary: a port nothing listens on fails fast (conn refused)
+    client = FailoverServeClient(
+        [("127.0.0.1", 1), ("127.0.0.1", replica.bound_port)],
+        timeout_s=5.0)
+    try:
+        reply = client.predict(np.array([2], np.int32),
+                               np.array([1.0], np.float32))
+        assert reply.margin == pytest.approx(float(w[2]))
+        assert client.failovers == 1
+        client.predict(np.array([0], np.int32), np.array([1.0], np.float32))
+        assert client.failovers == 1  # sticky: no re-probe of the corpse
+        assert client.health().ok
+    finally:
+        client.close()
+        replica.stop()
+
+    dead = FailoverServeClient([("127.0.0.1", 1), ("127.0.0.1", 2)],
+                               timeout_s=1.0)
+    with pytest.raises(grpc.RpcError):
+        dead.predict(np.array([0], np.int32), np.array([1.0], np.float32))
+    dead.close()
+    with pytest.raises(ValueError):
+        FailoverServeClient([])
+
+
+# -- load-adaptive replica autoscale -----------------------------------------
+
+
+def _scaler(signals, t, count, **kw):
+    from distributed_sgd_tpu.serving.ha import ReplicaAutoscaler
+
+    sig = iter(signals)
+    kw.setdefault("slo_ms", 100.0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_after", 2)
+    kw.setdefault("down_after", 3)
+    kw.setdefault("low_water", 0.3)
+    kw.setdefault("cooldown_s", 10.0)
+    return ReplicaAutoscaler(
+        signal_ms=lambda: next(sig),
+        scale_up=lambda: count.append(count[-1] + 1),
+        scale_down=lambda: count.append(count[-1] - 1),
+        count=lambda: count[-1], metrics=Metrics(),
+        clock=lambda: t[0], **kw)
+
+
+def test_autoscaler_hysteresis_up_and_cooldown():
+    t, count = [0.0], [2]
+    s = _scaler([500, 500, 500, 500, 500, 500], t, count)
+    assert s.step() is None       # 1 breach tick: not yet (up_after=2)
+    assert s.step() == "up"       # 2 CONSECUTIVE: spin up
+    assert count[-1] == 3
+    assert s.step() is None       # cooldown dead window
+    t[0] = 11.0                   # cooldown over; streak restarts at 0
+    assert s.step() is None
+    assert s.step() == "up"
+    assert count[-1] == 4
+
+
+def test_autoscaler_inband_tick_resets_the_streak():
+    t, count = [0.0], [1]
+    # breach, in-band, breach, breach: only the last two are consecutive
+    s = _scaler([500, 50, 500, 500], t, count)
+    assert s.step() is None
+    assert s.step() is None       # in-band: streak reset
+    assert s.step() is None
+    assert s.step() == "up"
+
+
+def test_autoscaler_down_low_water_and_clamps():
+    t, count = [0.0], [3]
+    # sustained idle (below low_water * slo = 30): drain after 3 ticks,
+    # then clamp at min_replicas
+    s = _scaler([10] * 12, t, count, min_replicas=2, cooldown_s=0.0)
+    assert [s.step() for _ in range(3)] == [None, None, "down"]
+    assert count[-1] == 2
+    assert [s.step() for _ in range(6)] == [None] * 6  # min clamp
+    assert count[-1] == 2
+
+    t2, count2 = [0.0], [4]
+    s2 = _scaler([500] * 6, t2, count2, max_replicas=4, cooldown_s=0.0)
+    assert [s2.step() for _ in range(6)] == [None] * 6  # max clamp
+    assert count2[-1] == 4
+
+
+def test_autoscaler_none_signal_resets_streaks():
+    t, count = [0.0], [1]
+    # an outage (no eligible replica) is the health loop's problem: the
+    # None ticks must not accumulate toward a scaling verdict
+    s = _scaler([500, None, 500, 500], t, count)
+    assert s.step() is None
+    assert s.step() is None
+    assert s.step() is None       # streak restarted after the None
+    assert s.step() == "up"
+
+
+def test_autoscaler_validation():
+    from distributed_sgd_tpu.serving.ha import ReplicaAutoscaler
+
+    def mk(**kw):
+        kw.setdefault("slo_ms", 100.0)
+        return ReplicaAutoscaler(lambda: 0.0, lambda: None, lambda: None,
+                                 lambda: 1, **kw)
+
+    with pytest.raises(ValueError, match="slo_ms"):
+        mk(slo_ms=0.0)
+    with pytest.raises(ValueError, match="min_replicas"):
+        mk(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="low_water"):
+        mk(low_water=1.5)
+    with pytest.raises(ValueError, match="up_after"):
+        mk(up_after=0)
+    with pytest.raises(ValueError, match="cooldown"):
+        mk(cooldown_s=-1.0)
+
+
+def test_router_load_ms_is_the_worst_eligible_score(tmp_path):
+    from distributed_sgd_tpu.serving.ha import router_load_ms
+    from distributed_sgd_tpu.serving.router import ServingRouter
+
+    r = ServingRouter([("127.0.0.1", 1)], metrics=Metrics())
+    # the lone replica never passed a health check: no eligible set
+    assert router_load_ms(r) is None
+    rep = r._replicas[0]
+    rep.healthy = True
+    rep.ewma_s = 0.050
+    rep.inflight = 1
+    assert router_load_ms(r) == pytest.approx(100.0)  # 50ms x (1 + 1)
+    r.stop(grace=0.1)
+
+
+def test_fleet_add_and_drain_replica_live(tmp_path):
+    """Autoscale's fleet membership path: a spun-up replica joins warm
+    (it serves the promoted version before its first checkpoint poll) and
+    a drain refuses to take the last replica down."""
+    from distributed_sgd_tpu.serving.fleet import ServingFleet
+    from distributed_sgd_tpu.serving.push import WeightPusher
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=64).astype(np.float32)
+    w[w == 0] = 0.1
+    _save(tmp_path, 1, w)
+    with ServingFleet(str(tmp_path), n_replicas=1, ckpt_poll_s=60.0,
+                      health_s=0.2, metrics=Metrics()) as f:
+        pusher = WeightPusher([("127.0.0.1", f.router_port)],
+                              metrics=Metrics())
+        assert pusher.push(2, w) == 1
+        pusher.close()
+        r = f.add_replica()
+        assert len(f.replicas) == 2
+        assert r.store.step == 2  # warmed with the promoted weights
+        assert len(f.router._replicas) == 2
+        assert f.drain_replica() is True
+        assert len(f.replicas) == 1
+        assert f.drain_replica() is False  # never below one replica
+
+
+# -- proto surface + knobs-off byte-identity ---------------------------------
+
+
+def test_sync_serve_state_proto_surface_pinned(ha_pair):
+    """The HA splice is NEW-messages-only: the SyncServeState pair's field
+    lists are pinned exactly, the pre-HA serving messages are untouched,
+    and a REPLICA (an 'older binary' for this method) answers
+    UNIMPLEMENTED — which the coordinator already counts as a sync error
+    rather than a crash."""
+    assert [(f.name, f.number)
+            for f in pb.SyncServeStateRequest.DESCRIPTOR.fields] == [
+        ("node", 1), ("seq", 2), ("has_promoted", 3),
+        ("promoted_version", 4), ("has_best", 5), ("best_loss", 6),
+        ("rejected", 7), ("decider", 8)]
+    assert [(f.name, f.number)
+            for f in pb.SyncServeStateReply.DESCRIPTOR.fields] == [
+        ("applied", 1), ("seq", 2), ("has_promoted", 3),
+        ("promoted_version", 4), ("has_best", 5), ("best_loss", 6),
+        ("rejected", 7)]
+    # the pre-HA wire forms are frozen: no fields spliced into them
+    assert [f.name for f in pb.PredictRequest.DESCRIPTOR.fields] == [
+        "indices", "values"]
+    assert [f.name for f in pb.PushWeightsRequest.DESCRIPTOR.fields] == [
+        "version", "weights", "delta"]
+    assert [f.name for f in pb.ServeHealthReply.DESCRIPTOR.fields] == [
+        "ok", "model_step", "queue_depth"]
+
+    host, port = ha_pair["endpoints"][0]
+    channel = new_channel(host, port)
+    with pytest.raises(grpc.RpcError) as ei:
+        ServeStub(channel).SyncServeState(
+            pb.SyncServeStateRequest(node="x", seq=1), timeout=5)
+    assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+    channel.close()
+
+
+def test_knobs_off_issues_no_sync_rpcs_and_adopts_nothing(tmp_path):
+    """The byte-identity spy: with DSGD_SERVE_HA unset the whole
+    promote/rollback/predict flow never issues a SyncServeState RPC (the
+    handler itself is the spy — any caller would trip it), the HA
+    counters stay zero, and an unsolicited peer record is answered but
+    NOT adopted."""
+    from distributed_sgd_tpu.serving.push import WeightPusher
+    from distributed_sgd_tpu.serving.router import ServingRouter
+    from distributed_sgd_tpu.serving.server import ServingServer
+
+    calls = []
+
+    class SpyRouter(ServingRouter):
+        def SyncServeState(self, request, context):  # noqa: N802
+            calls.append(request.node)
+            return super().SyncServeState(request, context)
+
+    rng = np.random.default_rng(5)
+    w = rng.normal(size=64).astype(np.float32)
+    w[w == 0] = 0.1
+    _save(tmp_path, 1, w)
+    replicas = [ServingServer(str(tmp_path), port=0, host="127.0.0.1",
+                              ckpt_poll_s=60.0, metrics=Metrics()).start()
+                for _ in range(2)]
+    m = Metrics()
+    router = SpyRouter([("127.0.0.1", r.bound_port) for r in replicas],
+                       port=0, host="127.0.0.1", canary_fraction=0.5,
+                       probe=_probe_rows(w), health_s=0.2,
+                       request_timeout_s=5.0, metrics=m).start()
+    try:
+        pusher = WeightPusher([("127.0.0.1", router.bound_port)],
+                              metrics=Metrics())
+        w2 = w.copy()
+        w2[0] *= 1.001
+        assert pusher.push(2, w2) == 1        # promote
+        assert pusher.push(3, -5.0 * w) == 0  # rollback
+        channel = new_channel("127.0.0.1", router.bound_port)
+        stub = ServeStub(channel)
+        reply = stub.Predict(pb.PredictRequest(
+            indices=np.array([0], np.int32),
+            values=np.array([1.0], np.float32)), timeout=5)
+        assert reply.model_step == 2
+        assert stub.ServeHealth(pb.Empty(), timeout=5).ok
+        pusher.close()
+
+        # the entire flow issued ZERO SyncServeState calls, and none of
+        # the HA instruments ever moved: the wire is the pre-HA wire
+        assert calls == []
+        for name in (mm.ROUTER_HA_SYNCS, mm.ROUTER_HA_SYNC_ERRORS,
+                     mm.ROUTER_HA_APPLIED, mm.ROUTER_HA_DEFERRED,
+                     mm.ROUTER_HA_FAILOVERS):
+            assert m.counter(name).value == 0, name
+
+        # a misconfigured peer probing us learns our record but cannot
+        # steer a router that has HA off — even with a huge seq
+        peer = pb.SyncServeStateRequest(node="rogue:1", seq=999,
+                                        has_promoted=True,
+                                        promoted_version=777)
+        ans = stub.SyncServeState(peer, timeout=5)
+        assert calls == ["rogue:1"]  # the spy proves the wire path works
+        assert not ans.applied
+        assert ans.has_promoted and ans.promoted_version == 2
+        assert list(ans.rejected) == [3]
+        assert router.promoted_version == 2  # nothing adopted
+        channel.close()
+    finally:
+        router.stop(grace=0.1)
+        for r in replicas:
+            r.stop()
+
+
+# -- config knobs ------------------------------------------------------------
+
+
+def test_config_ha_knobs_env_and_validation(monkeypatch):
+    from distributed_sgd_tpu.config import Config
+
+    for key, value in {
+        "DSGD_ROLE": "route",
+        "DSGD_SERVE_TARGETS": "10.0.0.5:4100,10.0.0.6:4100",
+        "DSGD_SERVE_HA": "peers:10.0.0.9:4100;sync=100ms",
+        "DSGD_SERVE_SLO_MS": "250",
+        "DSGD_SERVE_SCALE_MAX": "6",
+        "DSGD_SERVE_SCALE_COOLDOWN_S": "2.5",
+    }.items():
+        monkeypatch.setenv(key, value)
+    cfg = Config.from_env()
+    assert cfg.serve_ha == "peers:10.0.0.9:4100;sync=100ms"
+    assert (cfg.serve_slo_ms, cfg.serve_scale_max,
+            cfg.serve_scale_cooldown_s) == (250.0, 6, 2.5)
+
+    with pytest.raises(ValueError, match="router knob"):
+        Config(role_override="serve", checkpoint_dir="/tmp/ck",
+               serve_ha="peers:h:1")
+    with pytest.raises(ValueError, match="peers:"):  # typo fails at boot
+        Config(role_override="route", serve_targets="h:1",
+               serve_ha="h2:4100")
+    with pytest.raises(ValueError, match="DSGD_SERVE_SLO_MS"):
+        Config(serve_slo_ms=-1.0)
+    with pytest.raises(ValueError, match="DSGD_SERVE_REPLICAS"):
+        Config(role_override="serve", checkpoint_dir="/tmp/ck",
+               serve_slo_ms=5.0, serve_replicas=0)
+    with pytest.raises(ValueError, match="scale floor"):
+        Config(role_override="serve", checkpoint_dir="/tmp/ck",
+               serve_replicas=4, serve_slo_ms=5.0, serve_scale_max=2)
+    with pytest.raises(ValueError, match="DSGD_SERVE_SCALE_COOLDOWN_S"):
+        Config(serve_scale_cooldown_s=-0.1)
