@@ -175,6 +175,16 @@ class FileLease:
         except OSError as e:
             log.warning("lease write to %s failed: %s", self.path, e)
             return False  # cannot prove the claim: act as non-decider
+        # read-back check: two routers racing the same expired record can
+        # both atomic_write_json their claim — last writer wins, so only
+        # the router the file NAMES after the dust settles may decide
+        # (the loser sees the winner's record and defers immediately
+        # instead of a full term of silent split-brain)
+        rec = self._read()
+        if rec is None or rec["holder"] != self.node:
+            if rec is not None:
+                self.term = rec["term"]
+            return False
         return True
 
     def release(self) -> None:
@@ -302,6 +312,13 @@ class HACoordinator:
         return self._refresh()
 
     def _refresh(self) -> bool:
+        # lock order: NEVER hold _lock while taking the router's
+        # _push_lock — push RPCs hold _push_lock and call is_decider()
+        # (-> _lock), so detecting the lapse happens inside the critical
+        # section but the assume-lease re-pin runs after releasing it.
+        # _was_decider flips under _lock, so exactly one thread sees the
+        # False->True edge and runs the callback.
+        assumed = False
         with self._lock:
             now = self._lease.acquire()
             if not now:
@@ -310,16 +327,18 @@ class HACoordinator:
                 # the lease LAPSED onto us: the previous decider went
                 # quiet for a full TTL — assume its duties and re-pin the
                 # mirrored promoted state so the fleet serves one truth
-                self.metrics.counter(
-                    metrics_mod.ROUTER_HA_FAILOVERS).increment()
-                log.warning("HA lease assumed by %s (peer decider lapsed)",
-                            self.node)
-                if self._router is not None:
-                    self._router._on_assume_lease()
+                assumed = True
             self._was_decider = now
             self.metrics.gauge(metrics_mod.ROUTER_HA_DECIDER).set(
                 1.0 if now else 0.0)
-            return now
+        if assumed:
+            self.metrics.counter(
+                metrics_mod.ROUTER_HA_FAILOVERS).increment()
+            log.warning("HA lease assumed by %s (peer decider lapsed)",
+                        self.node)
+            if self._router is not None:
+                self._router._on_assume_lease()
+        return now
 
     def observe_peer(self, peer: str) -> None:
         self._lease.observe(peer)
@@ -359,18 +378,20 @@ class HACoordinator:
                 reply = stub.SyncServeState(
                     req, timeout=self._policy.deadline_s)
             except grpc.RpcError as e:
-                if e.code() != grpc.StatusCode.UNIMPLEMENTED:
-                    # dead/unreachable peer: its silence is what ages the
-                    # lease out.  UNIMPLEMENTED (an older binary) also
-                    # counts as an error — but its server answered, so it
-                    # is alive for lease purposes either way.
-                    pass
+                if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                    # older binary without the SyncServeState splice: it
+                    # cannot mirror state, but its server ANSWERED, so it
+                    # is alive for lease purposes — without observe() the
+                    # higher-ranked router would wrongly assume decider-
+                    # ship from a merely-old peer after one TTL
+                    self._lease.observe(peer)
+                # either way the sync itself failed (dead/unreachable
+                # peer silence is what ages the lease out)
                 self.metrics.counter(
                     metrics_mod.ROUTER_HA_SYNC_ERRORS).increment()
                 continue
             answered += 1
             self._lease.observe(peer)
-            self.metrics.counter(metrics_mod.ROUTER_HA_SYNCS).increment()
             if reply.seq > snap["seq"]:
                 # the peer is ahead (we are the rejoining/stale side):
                 # adopt its record — this is the no-resurrection path

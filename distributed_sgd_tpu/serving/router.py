@@ -1003,11 +1003,28 @@ class ServingRouter:
         truth, whatever the dead decider was midway through.  The seq is
         NOT bumped — assuming the lease is not a state transition, and a
         rejoining ex-decider whose record is genuinely newer (it finished
-        a verdict before dying) must still win the next exchange."""
+        a verdict before dying) must still win the next exchange.
+
+        The network fan-out runs OUTSIDE _push_lock (only the snapshot of
+        the promoted record is taken under it): holding the lock through a
+        deadline x replicas push would stall every is_decider() read,
+        PushWeights, and SyncServeState exactly when the survivor must
+        take over."""
         with self._push_lock:
             if self._promoted_version is None:
                 return
-            self._repin(self._replicas)
+            if self._w_promoted is None:
+                # restored-state router that has not yet re-received the
+                # promoted weights: nothing to re-install — the fleet
+                # heals when the promoted version is re-streamed
+                log.warning("cannot re-pin fleet on lease assumption: "
+                            "promoted weights not in cache yet "
+                            "(restored state)")
+                return
+            req = pb.PushWeightsRequest(version=self._promoted_version)
+            req.weights.CopyFrom(codec.encode_tensor(self._w_promoted))
+            replicas = list(self._replicas)
+        self._fan_out(req, replicas)
 
     # -- fleet membership (autoscale: serving/ha.py ReplicaAutoscaler) -------
 

@@ -504,7 +504,7 @@ ROUTER_PROBE_FILL = "router.canary.probe_fill"        # gauge: reservoir rows he
 
 # serving-plane HA + autoscale (serving/ha.py; docs/SERVING.md "HA")
 ROUTER_HA_DECIDER = "router.ha.decider"              # gauge: 1 = holds the decider lease
-ROUTER_HA_SYNCS = "router.ha.syncs"                  # counter: peer state syncs delivered
+ROUTER_HA_SYNCS = "router.ha.syncs"                  # counter: inbound peer sync exchanges served
 ROUTER_HA_SYNC_ERRORS = "router.ha.sync_errors"      # counter: peer syncs that failed
 ROUTER_HA_APPLIED = "router.ha.applied"              # counter: peer records adopted locally
 ROUTER_HA_DEFERRED = "router.ha.deferred"            # counter: pushes deferred (not decider)

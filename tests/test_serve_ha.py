@@ -104,6 +104,32 @@ def test_file_lease_corrupt_record_is_claimable(tmp_path):
     assert lease.acquire()
 
 
+def test_file_lease_read_back_detects_a_lost_write_race(tmp_path,
+                                                        monkeypatch):
+    """Two routers racing the same expired record can BOTH land their
+    atomic_write_json claim (the write is atomic, the read-then-write is
+    not).  The read-back check makes the earlier writer see the winner's
+    record and defer immediately, instead of a full term of silent
+    dual-decider split-brain."""
+    from distributed_sgd_tpu.serving import ha
+    from distributed_sgd_tpu.serving.ha import FileLease
+
+    path = str(tmp_path / "lease.json")
+    a = FileLease(path, "a", ttl_s=1.0, clock=lambda: 0.0)
+    real = ha.atomic_write_json
+
+    def b_lands_right_after(p, rec):
+        real(p, rec)
+        if rec["holder"] == "a":
+            real(p, {"holder": "b", "expiry": 1.0, "term": rec["term"]})
+
+    monkeypatch.setattr(ha, "atomic_write_json", b_lands_right_after)
+    assert not a.acquire(), "lost the write race yet claimed the lease"
+    monkeypatch.setattr(ha, "atomic_write_json", real)
+    b = FileLease(path, "b", ttl_s=1.0, clock=lambda: 0.5)
+    assert b.acquire()  # the file names b: b decides, a defers
+
+
 def test_peer_lease_rank_boot_presumption_and_lapse():
     from distributed_sgd_tpu.serving.ha import PeerLease
 
@@ -123,6 +149,93 @@ def test_peer_lease_rank_boot_presumption_and_lapse():
     nine = PeerLease("h:9", ["h:10"], ttl_s=1.0, clock=lambda: t[0])
     nine.observe("h:10")
     assert nine.acquire()
+
+
+class _RpcErr(grpc.RpcError):
+    def __init__(self, code):
+        self._code = code
+
+    def code(self):
+        return self._code
+
+
+class _FakeHaRouter:
+    """The three router hooks a bare HACoordinator touches."""
+
+    def export_ha_state(self):
+        return {"seq": 0, "promoted": None, "best": None, "rejected": []}
+
+    def apply_ha_record(self, record):
+        return False
+
+    def _on_assume_lease(self):
+        pass
+
+
+def _bare_coordinator(t, stub=None):
+    """A coordinator on fakes: high-ranked node 'h:2' with one low-ranked
+    peer 'h:1' under a 1s peer lease on the fake clock `t`, no network."""
+    from distributed_sgd_tpu.rpc.service import RpcPolicy
+    from distributed_sgd_tpu.serving.ha import HACoordinator, PeerLease
+
+    c = HACoordinator(["h:1"], node="h:2", sync_s=60.0, lease_ttl_s=600.0,
+                      metrics=Metrics(), policy=RpcPolicy())
+    c._lease = PeerLease("h:2", ["h:1"], ttl_s=1.0, clock=lambda: t[0])
+    c._router = _FakeHaRouter()
+    if stub is not None:
+        c._stubs = {"h:1": stub}
+    return c
+
+
+def test_unimplemented_peer_counts_as_alive_for_the_lease():
+    """An older-binary peer answers SyncServeState with UNIMPLEMENTED: it
+    cannot mirror state (a sync error) but its server ANSWERED, so the
+    lease must see it alive — otherwise the higher-ranked router would
+    usurp decidership from a merely-old peer after one TTL.  A transport
+    error, by contrast, feeds nothing: that silence ages the lease out."""
+
+    class _Stub:
+        code = grpc.StatusCode.UNIMPLEMENTED
+
+        def SyncServeState(self, req, timeout=None):  # noqa: N802
+            raise _RpcErr(self.code)
+
+    t = [0.0]
+    stub = _Stub()
+    c = _bare_coordinator(t, stub=stub)
+    t[0] = 0.9
+    assert c.sync_once() == 0  # the sync itself failed...
+    assert c.metrics.counter(mm.ROUTER_HA_SYNC_ERRORS).value == 1
+    t[0] = 1.5
+    assert not c.is_decider()  # ...but the peer was seen alive at 0.9
+    stub.code = grpc.StatusCode.UNAVAILABLE
+    t[0] = 1.8
+    c.sync_once()              # a DEAD peer feeds no liveness
+    t[0] = 2.5                 # 0.9 + ttl long past: the peer lapsed
+    assert c.is_decider()
+
+
+def test_assume_lease_callback_runs_outside_the_coordinator_lock():
+    """Regression: _refresh used to invoke the router's assume-lease
+    re-pin while holding the coordinator lock — an ABBA deadlock against
+    push RPCs, which hold the router's _push_lock and ask is_decider().
+    The callback must fire AFTER the lock is released, exactly once per
+    lapse."""
+    t = [0.0]
+    c = _bare_coordinator(t)
+    held = []
+
+    def spy():
+        held.append(c._lock.locked())
+
+    c._router._on_assume_lease = spy
+    assert not c.is_decider()  # peer presumed alive at boot: defer
+    t[0] = 2.0                 # the decider went quiet for a full TTL
+    assert c.is_decider()
+    assert held == [False], "re-pin ran under the coordinator lock"
+    assert c.is_decider()      # steady state: no second callback
+    assert held == [False]
+    assert c.metrics.counter(mm.ROUTER_HA_FAILOVERS).value == 1
 
 
 def test_coordinator_validation():
