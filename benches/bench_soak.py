@@ -40,6 +40,18 @@ reload accounting this soak gates stays clean (the old hedge=False ban
 existed because hedges used to route through ensure_rows; see
 docs/HIERARCHY.md and docs/AGGREGATION.md).
 
+Long-horizon mode (ISSUE 20, telemetry/resources.py): the soak is also
+the leak proof.  A real ``ResourceProbe`` thread samples the process
+across the whole chaos run while a ``LeakSentinel`` with CALIBRATED
+absolute slope bars (rss bytes/s, fds/s, threads/s — the bench_flywheel
+PR 16 calibration) watches the series; the bench hard-asserts the
+sentinel never tripped AND the final Theil–Sen slopes sit under the
+bars, then measures probe overhead on the canonical ``--rpc`` workload
+(interleaved base/probe-on, per-config minimum, the bench_telemetry
+pattern) against a <5% bar.  ``soak_rss_slope`` / ``soak_fd_slope``
+rows land in benches/history.json so the trend across rounds is
+watchable even while each run's absolute bar passes.
+
 Run: ``python bench.py --soak [--smoke]``.  One JSON line on stdout;
 diagnostics to stderr; rows append to benches/history.json under the
 ``soak_*`` series (loss fields carry their own in-run parity gate — the
@@ -61,6 +73,30 @@ POOL = 4
 PARITY_REL = 1.02
 PARITY_ABS = 0.02
 DELTA_SLACK = 1.5
+
+# -- long-horizon leak gate (ISSUE 20) ----------------------------------------
+# Absolute slope bars fed to the LeakSentinel and re-asserted on the
+# final Theil–Sen fit.  RSS bars reuse the PR 16 bench_flywheel
+# calibration (smoke windows are shorter, so allocator warmup reads
+# steeper): 8 MB/s smoke / 4 MB/s full.  fds/threads churn with the
+# join/leave schedule by design — the bars bound a monotone LEAK, not
+# the sawtooth (Theil–Sen's pairwise median flattens the sawtooth).
+MAX_RSS_SLOPE = dict(smoke=8e6, full=4e6)   # bytes/s
+MAX_FD_SLOPE = 2.0                          # fds/s
+MAX_THREAD_SLOPE = 2.0                      # threads/s
+PROBE_S = dict(smoke=0.25, full=0.5)        # soak sampling cadence
+MIN_HORIZON_S = dict(smoke=5.0, full=10.0)  # sentinel horizon guard
+# probe-overhead gate on the canonical --rpc workload (the
+# bench_telemetry shapes + pattern): interleave base/probe-on, keep the
+# per-config MINIMUM, hard-assert < 5%.  The overhead probe ticks FAST
+# (0.1 s) so the bar is measured at 100x the production default cadence.
+OVERHEAD_SMOKE = dict(n=640, n_features=4096, nnz=8, batch=16, epochs=2,
+                      lr=0.5)
+OVERHEAD_FULL = dict(n=2560, n_features=16384, nnz=32, batch=16, epochs=4,
+                     lr=0.5)
+OVERHEAD_REPS = dict(smoke=1, full=2)
+OVERHEAD_PROBE_S = 0.1
+MAX_PROBE_OVERHEAD = 0.05
 
 # weather comes from the NAMED scenario library (chaos/__init__.py
 # SCENARIOS; DSGD_CHAOS=scenario:NAME) so this bench, a bug report, and
@@ -158,8 +194,9 @@ def _expected_delta_bound(f: float, counts, train_rows: int):
     return total
 
 
-def _run_soak(train, test, make, cfg: dict) -> dict:
+def _run_soak(train, test, make, cfg: dict, label: str) -> dict:
     from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.telemetry import resources, slope
     from distributed_sgd_tpu.utils import metrics as mm
 
     g = mm.global_metrics()
@@ -168,6 +205,16 @@ def _run_soak(train, test, make, cfg: dict) -> dict:
     counts = [n0]
     executed = []
     stop = threading.Event()
+
+    # long-horizon watch: a REAL probe thread (the production path, not a
+    # test-driven tick loop) sampling across the whole soak, the sentinel
+    # on absolute calibrated bars
+    sentinel = slope.LeakSentinel(
+        metrics=g, min_horizon_s=MIN_HORIZON_S[label],
+        thresholds={"rss": MAX_RSS_SLOPE[label], "fds": MAX_FD_SLOPE,
+                    "threads": MAX_THREAD_SLOPE})
+    probe = resources.ResourceProbe(
+        metrics=g, interval_s=PROBE_S[label], sentinel=sentinel).start()
 
     with DevCluster(make(), train, test, n_workers=n0, seed=0,
                     heartbeat_s=cfg["heartbeat_s"],
@@ -227,11 +274,67 @@ def _run_soak(train, test, make, cfg: dict) -> dict:
         after_members = len(c.master._workers)
         d = {k: g.counter(name).value - before[k]
              for k, name in gated_counters.items()}
+    probe.stop()
     return {
         "res": res, "wall": wall, "counters": d, "counts": counts,
         "executed": executed, "survivors": after_members,
         "final_loss": float(res.losses[-1]),
         "weights": np.asarray(res.state.weights),
+        "sentinel": sentinel, "probe_ticks": probe.ticks,
+        "rss_slope": sentinel.slope("rss"),
+        "fd_slope": sentinel.slope("fds"),
+    }
+
+
+def _probe_overhead(label: str) -> dict:
+    """Probe-overhead gate on the canonical --rpc workload: interleaved
+    base/probe-on fits, per-config MINIMUM (loopback gRPC on a shared
+    host is noisy upward, never downward), hard < 5% assert — the
+    bench_telemetry pattern, with the probe ticking at 0.1 s (100x the
+    production default cadence)."""
+    from benches.bench_rpc_sync import _build as build_rpc_workload
+    from distributed_sgd_tpu.core.cluster import DevCluster
+    from distributed_sgd_tpu.telemetry import resources
+
+    cfg = OVERHEAD_SMOKE if label == "smoke" else OVERHEAD_FULL
+    reps = OVERHEAD_REPS[label]
+    train, test, make = build_rpc_workload(cfg)
+
+    def fit(probe_on: bool) -> float:
+        with DevCluster(make(), train, test, n_workers=2, seed=0) as c:
+            probe = (resources.ResourceProbe(
+                interval_s=OVERHEAD_PROBE_S).start() if probe_on else None)
+            try:
+                t0 = time.perf_counter()
+                c.master.fit_sync(max_epochs=cfg["epochs"],
+                                  batch_size=cfg["batch"],
+                                  learning_rate=cfg["lr"])
+                return time.perf_counter() - t0
+            finally:
+                if probe is not None:
+                    probe.stop()
+
+    base = probed = float("inf")
+    ticks = 0
+    for rep in range(reps):
+        w = fit(False)
+        base = min(base, w)
+        log(f"  overhead rep {rep}: base  {w:.2f}s")
+        w = fit(True)
+        probed = min(probed, w)
+        log(f"  overhead rep {rep}: probe {w:.2f}s")
+    overhead = probed / base - 1.0
+    log(f"probe overhead: {overhead:+.1%} (base {base:.2f}s, probed "
+        f"{probed:.2f}s at {OVERHEAD_PROBE_S}s cadence; bar: "
+        f"< {MAX_PROBE_OVERHEAD:.0%})")
+    assert overhead <= MAX_PROBE_OVERHEAD, (
+        f"resource probe costs {overhead:+.1%} on the rpc sync workload — "
+        f"over the {MAX_PROBE_OVERHEAD:.0%} bar (base {base:.2f}s, probed "
+        f"{probed:.2f}s)")
+    return {
+        "probe_overhead_frac_info": round(overhead, 4),
+        "probe_base_wall_s_info": round(base, 3),
+        "probe_on_wall_s_info": round(probed, 3),
     }
 
 
@@ -262,7 +365,7 @@ def run_bench(smoke: bool = False) -> dict:
     base_loss = float(base.losses[-1])
     log(f"baseline: loss={base_loss:.6f} ({base_wall:.1f}s clear weather)")
 
-    soak = _run_soak(train, test, make, cfg)
+    soak = _run_soak(train, test, make, cfg, label)
     d = soak["counters"]
     transitions = len(soak["counts"]) - 1
     bound = _expected_delta_bound(
@@ -302,7 +405,31 @@ def run_bench(smoke: bool = False) -> dict:
         f"bound {parity_bound:.6f}")
     assert d["stage_hits"] > 0, "the soak never dispatched a staged draw"
 
+    # -- long-horizon leak gate (ISSUE 20) --------------------------------
+    sentinel = soak["sentinel"]
+    rss_slope, fd_slope = soak["rss_slope"], soak["fd_slope"]
+    log(f"leak watch: {soak['probe_ticks']} probe ticks, rss slope "
+        f"{rss_slope:g} B/s (bar {MAX_RSS_SLOPE[label]:g}), fd slope "
+        f"{fd_slope:g}/s (bar {MAX_FD_SLOPE:g}), tripped="
+        f"{sorted(sentinel.tripped_series) or 'none'}")
+    assert not sentinel.tripped(), (
+        f"the leak sentinel tripped during the soak: "
+        f"{sorted(sentinel.tripped_series)} — read the flight-*-leak.json "
+        f"dump")
+    assert rss_slope == rss_slope and fd_slope == fd_slope, (
+        f"the probe never accumulated a judgeable window "
+        f"({soak['probe_ticks']} ticks) — the leak gate measured nothing")
+    assert rss_slope <= MAX_RSS_SLOPE[label], (
+        f"rss slope {rss_slope:g} B/s over the {MAX_RSS_SLOPE[label]:g} "
+        f"B/s bar across the chaos soak")
+    assert fd_slope <= MAX_FD_SLOPE, (
+        f"fd slope {fd_slope:g}/s over the {MAX_FD_SLOPE:g}/s bar across "
+        f"the chaos soak")
+
+    overhead = _probe_overhead(label)
+
     return {
+        **overhead,
         "metric": f"soak_{label}",
         # headline, gated lower-is-better: soak wall seconds (the weather
         # and churn schedule are seeded/fixed, so this is reproducible)
@@ -328,15 +455,33 @@ def run_bench(smoke: bool = False) -> dict:
         "stage_hits": d["stage_hits"],
         "baseline_wall_s_info": round(base_wall, 2),
         "survivors": soak["survivors"],
+        # leak-watch context on the headline row (the dedicated
+        # soak_rss_slope/soak_fd_slope series below carry the gated trend)
+        "probe_ticks": soak["probe_ticks"],
+        "rss_slope_info": round(rss_slope, 2),
+        "fd_slope_info": round(fd_slope, 4),
     }
 
 
 def main(smoke: bool = False) -> None:
     result = run_bench(smoke=smoke)
+    label = "smoke" if smoke else "full"
+    # dedicated slope series (ISSUE 20): thin rows whose `*_slope` fields
+    # regress.py gates lower-is-better at the 100% slope band (skipping
+    # non-positive values) — the cross-round leak trend, beside the
+    # per-run absolute bars run_bench already hard-asserted
+    slope_rows = [
+        {"metric": f"soak_rss_slope_{label}", "unit": "bytes_per_s",
+         "rss_slope": result["rss_slope_info"],
+         "bar_info": MAX_RSS_SLOPE[label]},
+        {"metric": f"soak_fd_slope_{label}", "unit": "fds_per_s",
+         "fd_slope": result["fd_slope_info"], "bar_info": MAX_FD_SLOPE},
+    ]
     try:
         from benches import regress
 
-        regressions, lines = regress.check(result, regress.load_history())
+        history = regress.load_history()
+        regressions, lines = regress.check(result, history)
         result["regressed"] = regressions
         log(f"regression gate vs stored history, tolerance "
             f"{regress.DEFAULT_TOLERANCE:.0%}:")
@@ -348,6 +493,15 @@ def main(smoke: bool = False) -> None:
         else:
             regress.record(result)
             log("PASS: run appended to benches/history.json")
+        for row in slope_rows:
+            row_reg, row_lines = regress.check(row, history)
+            for ln in row_lines:
+                log(ln)
+            if row_reg:
+                result["regressed"] = result["regressed"] + row_reg
+                log(f"FAIL: {row['metric']} regressed (row NOT recorded)")
+            else:
+                regress.record(row)
     except Exception as e:  # noqa: BLE001 - gating must not break the bench
         log(f"regression gate skipped: {e}")
         result["regressed"] = None

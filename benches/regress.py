@@ -114,6 +114,16 @@ CLASS_TOLERANCES = (
     (("_rounds_per_s",), 0.35),
     (("_scale_eff",), 0.35),
     (("_recovery_rounds",), 0.50),
+    # leak slopes (`*_slope`, the bench_soak long-horizon rows): LOWER is
+    # better — Theil–Sen units/s of rss (bytes) or fds across the chaos
+    # soak.  A healthy soak's slope hovers around ZERO and flips sign with
+    # allocator/GC timing, so a relative band around the median is mostly
+    # noise-vs-noise; the 100% band only flags a slope that clearly
+    # doubles a genuinely positive median, and check() additionally skips
+    # gating entirely when either side is <= 0 (no leak to compare).  The
+    # bench's own absolute thresholds (MAX_*_SLOPE) are the load-bearing
+    # gate — the history rows exist to watch the trend across rounds.
+    (("_slope",), 1.00),
 )
 
 
@@ -180,7 +190,12 @@ def direction(name: str) -> Optional[str]:
     # final_acc alone is an insensitive proxy for a convergence break.
     # *_recovery_rounds gates DOWN: fewer probe-refresh rounds from
     # shift to recovered means a faster flywheel (bench_flywheel.py)
-    if (name.endswith(("_seconds", "_s", "_loss", "_recovery_rounds"))
+    # *_slope gates DOWN: a leak slope (units/s) growing across rounds is
+    # a slow-burn regression even when each run's absolute bar passes
+    # (bench_soak.py long-horizon rows; near-zero medians are exempted in
+    # check() — see CLASS_TOLERANCES)
+    if (name.endswith(("_seconds", "_s", "_loss", "_recovery_rounds",
+                       "_slope"))
             or name == "value"):
         return "down"
     return None
@@ -245,6 +260,13 @@ def check(
         med = median(prior)
         if med == 0:
             lines.append(f"  {name} = {value:g} (zero median, not gated)")
+            continue
+        if name.endswith("_slope") and (med <= 0 or value <= 0):
+            # a non-positive slope is no leak, and a ratio against a
+            # near-zero (or negative) median gates noise-vs-noise — the
+            # bench's absolute MAX_*_SLOPE bars are the real gate
+            lines.append(f"  {name} = {value:g} (non-positive slope, "
+                         f"not gated)")
             continue
         tol = tolerance_for(name, tolerance, series=series)
         ratio = value / med

@@ -135,6 +135,21 @@ class Config:
     # the fit — a dying run leaves evidence and a checkpoint instead of
     # a flat loss curve.
     health_action: Optional[str] = None
+    # -- long-horizon resource plane (telemetry/resources.py, ISSUE 20) ----
+    # resource-probe cadence in seconds: a dependency-free daemon thread
+    # samples /proc/self/{statm,fd,status}, gc stats, and the internal
+    # pressure gauges (drain inbox, trace buffer, flight ring, admission
+    # queue, compile cache) into proc.* gauges, and feeds the leak-slope
+    # sentinel (Theil–Sen over each series; a trip routes through
+    # health_action).  0 (default): no probe thread, no proc.* gauges, no
+    # blackbox files — knobs-off byte-identical.
+    resource_probe_s: float = 0.0
+    # crash-surviving blackbox ring dir (telemetry/blackbox.py): each probe
+    # tick appends a JSONL snapshot (resources + counters + round cursor)
+    # to bounded, atomically-rotated segments; read post-mortem with
+    # `python -m distributed_sgd_tpu.telemetry.blackbox`.  Requires
+    # resource_probe_s > 0 (the probe is the only writer).
+    blackbox_dir: Optional[str] = None
     metrics_port: Optional[int] = None  # Prometheus-style text exporter
     # InfluxDB write endpoint for the push reporter (reference parity:
     # Kamon InfluxDBReporter, application.conf:54-78), e.g.
@@ -455,6 +470,13 @@ class Config:
                 f"under the checkpoint directory")
         if self.flight_recorder < 0:
             raise ValueError("flight_recorder must be >= 0 (0 disables)")
+        if self.resource_probe_s < 0:
+            raise ValueError(
+                "DSGD_RESOURCE_PROBE_S must be >= 0 (0 = no resource probe)")
+        if self.blackbox_dir and self.resource_probe_s <= 0:
+            raise ValueError(
+                "DSGD_BLACKBOX_DIR needs DSGD_RESOURCE_PROBE_S > 0: the "
+                "resource probe is the blackbox's only writer")
         if self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
         if self.steps_per_dispatch < 1:
@@ -717,6 +739,9 @@ class Config:
             telemetry=_env("DSGD_TELEMETRY", cls.telemetry, bool),
             telemetry_port=_env("DSGD_TELEMETRY_PORT", cls.telemetry_port, int),
             health_action=_env("DSGD_HEALTH_ACTION", None, str),
+            resource_probe_s=_env("DSGD_RESOURCE_PROBE_S",
+                                  cls.resource_probe_s, float),
+            blackbox_dir=_env("DSGD_BLACKBOX_DIR", None, str),
             metrics_port=_env("DSGD_METRICS_PORT", None, int),
             influx_url=_env("DSGD_INFLUX_URL", None, str),
             profile_dir=_env("DSGD_PROFILE_DIR", None, str),

@@ -22,6 +22,7 @@ import collections
 import logging
 import threading
 import time
+import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
 import grpc
@@ -54,6 +55,7 @@ from distributed_sgd_tpu.rpc.service import (
     new_server,
 )
 from distributed_sgd_tpu import trace as trace_mod
+from distributed_sgd_tpu.telemetry import resources
 from distributed_sgd_tpu.trace import flight
 from distributed_sgd_tpu.utils import metrics as metrics_mod
 from distributed_sgd_tpu.utils.log import node_logger
@@ -927,6 +929,17 @@ class MasterNode:
         self._inbox: List[Tuple[np.ndarray, int]] = []
         self._inbox_cv = threading.Condition()
         self._drain_on = False
+        # long-horizon resource plane (telemetry/resources.py, ISSUE 20):
+        # publish the drain-inbox depth as a pressure source — a slowly
+        # filling inbox is the classic async-plane death.  The weakref
+        # closure returns None once this master is collected, which
+        # self-unregisters the source; registration is a dict insert, so
+        # knobs-off runs (no probe thread) never call it.
+        inbox_ref = weakref.ref(self)
+        self._inbox_pressure_token = resources.register_pressure(
+            metrics_mod.PROC_PRESSURE_DRAIN_INBOX,
+            lambda: (len(m._inbox) if (m := inbox_ref()) is not None
+                     else None))
         # endpoints that RE-registered while already members (a worker
         # process restarted on the same host:port before any eviction —
         # the new process idles with no assignment, heartbeats succeed,
@@ -1159,6 +1172,8 @@ class MasterNode:
         self._async_running.clear()
         self._async_done.set()
         self._close_streams()
+        resources.unregister_pressure(
+            metrics_mod.PROC_PRESSURE_DRAIN_INBOX, self._inbox_pressure_token)
         if self.telemetry_exporter is not None:
             self.telemetry_exporter.stop()
         self.server.stop(grace=1.0)

@@ -312,7 +312,15 @@ def _health_monitor(cfg: Config, metrics=None):
     from distributed_sgd_tpu.telemetry.health import HealthMonitor
 
     log.info("training-health monitor on: action=%s", cfg.health_action)
-    return HealthMonitor(metrics=metrics, action=cfg.health_action)
+    monitor = HealthMonitor(metrics=metrics, action=cfg.health_action)
+    # the leak-slope sentinel (resource probe, ISSUE 20) routes its trips
+    # through the same DSGD_HEALTH_ACTION machinery as a loss divergence
+    from distributed_sgd_tpu.telemetry import resources
+
+    probe = resources.active()
+    if probe is not None and probe.sentinel is not None:
+        probe.sentinel.attach_health(monitor)
+    return monitor
 
 
 def scenario_rpc(cfg: Config, train: Dataset, test: Dataset, model) -> None:
@@ -428,6 +436,27 @@ def main() -> None:
                      service=f"{role}-{cfg.port}", dir=trace_dir or ".")
     flight.install_signal_handler()
 
+    # long-horizon resource plane (telemetry/resources.py, ISSUE 20):
+    # DSGD_RESOURCE_PROBE_S > 0 starts the per-process probe thread —
+    # /proc + pressure gauges every tick, the leak-slope sentinel riding
+    # the series (trip action = DSGD_HEALTH_ACTION, default warn), and
+    # (DSGD_BLACKBOX_DIR) the crash-surviving blackbox ring.  Unset: no
+    # thread, no gauges, no files — byte-identical (asserted by test).
+    probe = None
+    if cfg.resource_probe_s > 0:
+        from distributed_sgd_tpu.telemetry import blackbox as blackbox_mod
+        from distributed_sgd_tpu.telemetry import resources, slope
+
+        sentinel = slope.LeakSentinel(metrics=metrics_mod.global_metrics())
+        box = (blackbox_mod.Blackbox(cfg.blackbox_dir,
+                                     service=f"{role}-{cfg.port}")
+               if cfg.blackbox_dir else None)
+        probe = resources.configure(cfg.resource_probe_s,
+                                    metrics=metrics_mod.global_metrics(),
+                                    sentinel=sentinel, blackbox=box)
+        log.info("resource probe on: every %gs (blackbox=%s)",
+                 cfg.resource_probe_s, cfg.blackbox_dir or "off")
+
     # record=true enables metric SHIPPING (the reference's Kamon reporter
     # flag, Main.scala:40-43); the transports are orthogonal and may both
     # run: DSGD_METRICS_PORT serves Prometheus pull, DSGD_INFLUX_URL pushes
@@ -463,6 +492,8 @@ def main() -> None:
         # metrics (incl. metrics.push.errors) are the ones that matter —
         # same for the trace buffer
         trace_mod.flush()
+        if probe is not None:
+            probe.stop()
         if exporter is not None:
             exporter.stop()
         if pusher is not None:

@@ -27,13 +27,16 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
+from distributed_sgd_tpu.telemetry import resources
 from distributed_sgd_tpu.trace import flight
 from distributed_sgd_tpu.utils import measure
+from distributed_sgd_tpu.utils import metrics as metrics_mod
 
 log = logging.getLogger("dsgd.serving")
 
@@ -105,6 +108,7 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._stopping = False
+        self._pressure_token: Optional[int] = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serve-batcher")
 
@@ -199,11 +203,26 @@ class MicroBatcher:
 
     def start(self) -> "MicroBatcher":
         self._thread.start()
+        # long-horizon resource plane (telemetry/resources.py, ISSUE 20):
+        # a RUNNING batcher publishes its admission-queue depth as a
+        # pressure source — rows stuck queued are the serving-plane slow
+        # fill.  Registration is a dict insert; with the probe off nobody
+        # ever calls the closure.  Weakref, so a leaked batcher reference
+        # can never pin the queue alive through the registry.
+        ref = weakref.ref(self)
+        self._pressure_token = resources.register_pressure(
+            metrics_mod.PROC_PRESSURE_ADMISSION_QUEUE,
+            lambda: (b.depth() if (b := ref()) is not None else None))
         return self
 
     def stop(self, timeout: float = 5.0) -> None:
         """Drain the queue (already-admitted rows still get answers), then
         stop the batcher thread.  Late `submit()`s raise RuntimeError."""
+        if self._pressure_token is not None:
+            resources.unregister_pressure(
+                metrics_mod.PROC_PRESSURE_ADMISSION_QUEUE,
+                self._pressure_token)
+            self._pressure_token = None
         with self._cond:
             self._stopping = True
             self._cond.notify_all()

@@ -205,7 +205,14 @@ class ServingRouter:
         if not 0.0 <= canary_fraction <= 1.0:
             raise ValueError("canary_fraction must be in [0, 1]")
         if metrics is None:
-            metrics = metrics_mod.global_metrics()
+            # fresh per-router registry, NOT the process global: an HA pair
+            # runs two routers in one process (tests, fleet drills), and
+            # two ClusterExporters re-exporting one shared registry would
+            # double-count every counter under BOTH `route:<port>` node
+            # labels on the cluster /metrics page.  Same isolation the
+            # serve:<port> replicas got in the fleet runner — the route
+            # role was the one gap (ISSUE 20).
+            metrics = metrics_mod.Metrics()
         self.metrics = metrics
         self._policy = policy or RpcPolicy(seed=seed, metrics=metrics)
         self._replicas = [_Replica(h, p, self._policy) for h, p in replicas]

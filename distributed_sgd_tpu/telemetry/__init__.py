@@ -23,6 +23,12 @@ all.  This package closes both gaps:
   Prometheus alert rules under ``kube/observability/`` — dashboards and
   alerts are DERIVED from the instrument-name constants, and
   tests/test_observability.py fails the build when they drift.
+- ``resources`` / ``slope`` / ``blackbox`` (ISSUE 20): the long-horizon
+  resource plane — a per-process ``ResourceProbe`` sampling /proc +
+  internal-pressure gauges at ``DSGD_RESOURCE_PROBE_S``, a
+  ``LeakSentinel`` running Theil–Sen slope detection over those series,
+  and a crash-surviving on-disk ``Blackbox`` snapshot ring under
+  ``DSGD_BLACKBOX_DIR`` with a post-mortem CLI.
 
 Everything is default-off: with ``DSGD_TELEMETRY`` unset no Metrics RPC
 is ever issued and the wire stays byte-identical (tests/test_telemetry.py
@@ -34,4 +40,14 @@ from distributed_sgd_tpu.telemetry.aggregate import (  # noqa: F401
     ClusterTelemetry,
     snapshot_metrics,
 )
+# NOTE: blackbox is deliberately NOT imported here — it is a `-m`-runnable
+# post-mortem CLI, and a package-level import would put the submodule in
+# sys.modules before runpy executes it (RuntimeWarning on every CLI use).
 from distributed_sgd_tpu.telemetry.health import HealthMonitor  # noqa: F401
+from distributed_sgd_tpu.telemetry.resources import (  # noqa: F401
+    ResourceProbe,
+    register_pressure,
+    sample_resources,
+    unregister_pressure,
+)
+from distributed_sgd_tpu.telemetry.slope import LeakSentinel  # noqa: F401

@@ -123,6 +123,13 @@ class HealthMonitor:
 
     # -- trip ---------------------------------------------------------------
 
+    def trip_external(self, reason: str, **info) -> bool:
+        """Trip on an EXTERNAL verdict (the leak-slope sentinel,
+        telemetry/slope.py): routes through the same latched evidence +
+        DSGD_HEALTH_ACTION machinery as a loss divergence, so fit_sync's
+        snapshot/halt handling covers resource leaks too."""
+        return self._trip(reason, **info)
+
     def _trip(self, reason: str, **info) -> bool:
         if self.tripped:
             return False  # latched: one dump / one action per fit

@@ -62,6 +62,11 @@ REFERENCED_INSTRUMENTS: Dict[str, str] = {
     mm.HEALTH_DRAIN_BACKLOG: _G,
     mm.HEALTH_LOSS_EWMA: _G,
     mm.HEALTH_TRIPPED: _C,
+    # long-horizon resource plane (telemetry/resources.py, ISSUE 20)
+    mm.PROC_RSS: _G,
+    mm.PROC_FDS: _G,
+    mm.PROC_THREADS: _G,
+    mm.HEALTH_LEAK_SUSPECT: _C,
     "master.sync.loss": _H,
     "master.sync.batch.duration": _H,
 }
@@ -77,6 +82,8 @@ CORE_INSTRUMENTS = (
     mm.QUORUM_DEGRADED,
     mm.TELEMETRY_SCRAPE_ERRORS,
     mm.BREAKER_OPEN,
+    mm.PROC_RSS,
+    mm.HEALTH_LEAK_SUSPECT,
 )
 
 
@@ -155,6 +162,15 @@ def dashboard() -> dict:
              "breaker opens (10m)"),
             (_prom(mm.HEALTH_DRAIN_BACKLOG), "drain backlog"),
         ], 12, 32),
+        _panel(11, "process resources per node (rss / fds / threads)", [
+            (_prom(mm.PROC_RSS), "rss {{role}} {{worker}}"),
+            (_prom(mm.PROC_FDS), "fds {{role}} {{worker}}"),
+            (_prom(mm.PROC_THREADS), "threads {{role}} {{worker}}"),
+        ], 0, 40),
+        _panel(12, "leak suspects (slope sentinel trips)", [
+            (f'increase({_prom(mm.HEALTH_LEAK_SUSPECT, "_total")}[10m])',
+             "leak trips (10m) {{role}} {{worker}}"),
+        ], 12, 40),
     ]
     return {
         "uid": "dsgd-cluster",
@@ -202,6 +218,12 @@ def alert_rules() -> str:
          "the async drain inbox is near its 1024 cap: arrivals outrun "
          "the drain thread and deltas will fall back to per-message "
          "apply"),
+        ("DsgdLeakSuspect", "warning", "1m",
+         f'increase({_prom(mm.HEALTH_LEAK_SUSPECT, "_total")}[10m]) > 0',
+         "the leak-slope sentinel tripped on a process resource series "
+         "(rss/fds/threads): read the flight-*-leak.json dump and the "
+         "blackbox ring (python -m distributed_sgd_tpu.telemetry.blackbox "
+         "summary $DSGD_BLACKBOX_DIR) before the process dies of it"),
         ("DsgdEfResidualGrowing", "warning", "10m",
          f'{_prom(mm.HEALTH_EF_RESIDUAL_NORM)} > 10 * '
          f'{_prom(mm.HEALTH_GRAD_NORM)}',

@@ -66,6 +66,7 @@ EVENT_TOPOLOGY_RESELECT = "topology.reselect"  # gossip edge re-routed past a br
 EVENT_HEALTH_TRIPPED = "health.tripped"        # training-health watchdog trip
 EVENT_AUTOPILOT_TRANSITION = "autopilot.transition"  # flywheel state change
 EVENT_SCATTER_SELECTED = "kernel.scatter"      # which scatter formulation ran
+EVENT_LEAK_SUSPECT = "leak.suspect"            # resource-slope sentinel trip
 
 
 class TraceContext(NamedTuple):
@@ -298,6 +299,13 @@ class Tracer:
     def events(self) -> List[dict]:
         with self._lock:
             return list(self._events)
+
+    def buffered(self) -> int:
+        """Events currently held (lock-free: len() of a list is GIL-atomic).
+        The resource probe's trace-buffer pressure gauge — a buffer that
+        only ever grows until flush is exactly the kind of slow fill the
+        long-horizon plane exists to see."""
+        return len(self._events)
 
     def flush(self) -> Optional[str]:
         """Write the full buffer as one Chrome trace-event JSON file

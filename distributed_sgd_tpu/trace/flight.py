@@ -69,6 +69,11 @@ class FlightRecorder:
     def snapshot(self) -> List[dict]:
         return list(self._buf)
 
+    def ring_len(self) -> int:
+        """Events currently held (lock-free; len() of a deque is
+        GIL-atomic).  The resource probe's flight-ring pressure gauge."""
+        return len(self._buf)
+
     def dump(self, reason: str,
              min_interval_s: float = 0.0) -> Optional[str]:
         """Write the ring's current contents; returns the path (None when
@@ -94,6 +99,7 @@ class FlightRecorder:
             "dumped_at_mono": time.monotonic(),
             "dumped_at_wall": time.time(),
             "capacity": self.capacity,
+            "resources": self._resources(),
             "events": self.snapshot(),
         }
         try:
@@ -109,6 +115,18 @@ class FlightRecorder:
         log.warning("flight recorder dumped %d event(s) -> %s",
                     len(payload["events"]), path)
         return path
+
+    @staticmethod
+    def _resources() -> Optional[dict]:
+        """Resource snapshot for the dump payload: every quorum/eviction/
+        crash dump carries RSS/fd/thread context for free (ISSUE 20).
+        Lazy import (dump is the cold path; record must stay import-free)
+        and guarded — a sampling failure must not break a post-mortem."""
+        try:
+            from distributed_sgd_tpu.telemetry import resources
+            return resources.sample_resources()
+        except Exception:  # noqa: BLE001 - never mask the original failure
+            return None
 
 
 _RECORDER: Optional[FlightRecorder] = None

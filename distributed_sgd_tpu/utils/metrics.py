@@ -610,6 +610,35 @@ AUTOPILOT_ROLLED_BACK = "autopilot.rolled_back"    # counter: retrains rolled ba
 PROC_RSS_BYTES = "process.rss_bytes"               # gauge: resident set size
 PROC_OPEN_FDS = "process.open_fds"                 # gauge: open file descriptors
 
+# -- long-horizon resource plane (telemetry/resources.py; ISSUE 20) -----------
+# The ResourceProbe daemon (DSGD_RESOURCE_PROBE_S) samples these every
+# tick: the /proc-backed process gauges (absent off-Linux — a never-set
+# gauge is NaN and stays off the wire), the interpreter-level gauges
+# (threads, gc), and the internal-pressure gauges read from the live
+# structures whose slow fill precedes an hours-horizon death (async
+# drain inbox, trace buffer, flight ring, serving admission queue,
+# compile-cache dir).  All land on the process registry, so the cluster
+# /metrics page re-exports them per node under the usual role/worker
+# labels.  Knobs off, the probe never runs and none of these registers.
+PROC_RSS = "proc.rss_bytes"                        # gauge: RSS from /proc/self/statm
+PROC_FDS = "proc.fds"                              # gauge: /proc/self/fd entries
+PROC_THREADS = "proc.threads"                      # gauge: OS threads (status; fallback: threading)
+PROC_GC_GEN2 = "proc.gc.gen2"                      # gauge: gen2 collections so far
+PROC_PRESSURE_DRAIN_INBOX = "proc.pressure.drain_inbox"      # gauge: async inbox depth
+PROC_PRESSURE_TRACE_BUFFER = "proc.pressure.trace_buffer"    # gauge: tracer events buffered
+PROC_PRESSURE_FLIGHT_RING = "proc.pressure.flight_ring"      # gauge: flight events held
+PROC_PRESSURE_ADMISSION_QUEUE = "proc.pressure.admission_queue"  # gauge: serving rows queued
+PROC_PRESSURE_COMPILE_CACHE = "proc.pressure.compile_cache_files"  # gauge: cache dir entries
+# leak-slope sentinel (telemetry/slope.py): the trip counter plus the
+# per-series slope gauge family (`health.leak.slope.<series>`, set at
+# trip time so the exposition carries the offending estimate)
+HEALTH_LEAK_SUSPECT = "health.leak.suspect"        # counter: sentinel trips
+HEALTH_LEAK_SLOPE = "health.leak.slope"            # gauge family prefix: tripped slope /s
+# blackbox timeseries (telemetry/blackbox.py): snapshots appended to the
+# on-disk ring this process lifetime (also written INTO each snapshot,
+# so a tail knows how much history the ring ever held)
+BLACKBOX_SNAPSHOTS = "blackbox.snapshots"          # counter: snapshots appended
+
 
 def sample_process_gauges(metrics: "Metrics") -> Tuple[float, float]:
     """Set PROC_RSS_BYTES / PROC_OPEN_FDS from /proc/self (Linux; a
@@ -716,7 +745,12 @@ class PrometheusExporter:
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
+        # shutdown() handshakes with serve_forever and BLOCKS FOREVER if
+        # the serving thread never ran — a constructed-but-never-started
+        # exporter (a router torn down before start()) must still close
+        # its bound socket without hanging the caller
+        if self._thread.is_alive():
+            self._server.shutdown()
         self._server.server_close()
 
 

@@ -363,7 +363,7 @@ def _provisioned_prom_identifiers():
     text = (open(os.path.join(KUBE_OBS, provision.DASHBOARD_FILE)).read()
             + open(os.path.join(KUBE_OBS, provision.ALERTS_FILE)).read())
     return set(re.findall(
-        r"\b(?:master|slave|health|rpc|comms|serve)_[a-z0-9_]+", text))
+        r"\b(?:master|slave|health|rpc|comms|serve|proc)_[a-z0-9_]+", text))
 
 
 def test_every_dashboard_and_alert_metric_exists_in_code():

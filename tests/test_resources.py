@@ -1,0 +1,410 @@
+"""Long-horizon resource plane (ISSUE 20): the per-process probe, the
+leak-slope sentinel, the crash-surviving blackbox ring, and the knobs-off
+contract (zero new threads, zero files, no proc.* gauges)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from distributed_sgd_tpu.telemetry import blackbox as blackbox_mod
+from distributed_sgd_tpu.telemetry import resources, slope
+from distributed_sgd_tpu.trace import flight
+from distributed_sgd_tpu.utils import metrics as mm
+from distributed_sgd_tpu.utils.metrics import Metrics
+
+
+# -- raw sampling -------------------------------------------------------------
+
+
+def test_sample_resources_reads_proc_on_linux():
+    sample = resources.sample_resources()
+    if sys.platform.startswith("linux"):
+        assert sample[mm.PROC_RSS] > 0
+        assert sample[mm.PROC_FDS] > 0
+    # platform-independent values are always present
+    assert sample[mm.PROC_THREADS] >= 1
+    assert mm.PROC_GC_GEN2 in sample
+    # the flight ring exists default-on, so its pressure gauge is always
+    # sampled
+    assert mm.PROC_PRESSURE_FLIGHT_RING in sample
+
+
+def test_sample_degrades_to_absent_keys_off_linux(monkeypatch):
+    """Off-Linux (or a hidden /proc) the /proc-backed keys VANISH — no
+    crash, no zeros-as-lies — and the interpreter-level ones survive."""
+    real_open = open
+
+    def no_proc(path, *a, **k):
+        if str(path).startswith("/proc/"):
+            raise OSError("no /proc here")
+        return real_open(path, *a, **k)
+
+    monkeypatch.setattr("builtins.open", no_proc)
+    monkeypatch.setattr(resources.os, "listdir",
+                        lambda p: (_ for _ in ()).throw(OSError("no /proc")))
+    sample = resources.sample_resources()
+    assert mm.PROC_RSS not in sample
+    assert mm.PROC_FDS not in sample
+    assert sample[mm.PROC_THREADS] >= 1  # threading fallback
+
+    # and a probe tick on the degraded sample neither crashes nor sets
+    # the absent gauges (a never-set gauge is NaN = off the wire)
+    m = Metrics()
+    probe = resources.ResourceProbe(metrics=m, interval_s=60.0)
+    probe.tick()
+    assert m.gauge(mm.PROC_RSS).value != m.gauge(mm.PROC_RSS).value
+    assert m.gauge(mm.PROC_THREADS).value >= 1
+
+
+def test_pressure_registry_sums_and_self_cleans():
+    name = "proc.pressure.test_registry"
+    t1 = resources.register_pressure(name, lambda: 3.0)
+    t2 = resources.register_pressure(name, lambda: 4.0)
+    dead = resources.register_pressure(name, lambda: None)  # dead owner
+    raising = resources.register_pressure(
+        name, lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    try:
+        assert resources._sample_pressures()[name] == 7.0
+        # the None-returning and raising sources were dropped AND removed
+        assert resources._sample_pressures()[name] == 7.0
+        with resources._PRESSURE_LOCK:
+            assert set(resources._PRESSURE[name]) == {t1, t2}
+    finally:
+        for tok in (t1, t2, dead, raising):
+            resources.unregister_pressure(name, tok)
+    assert name not in resources._sample_pressures()
+
+
+def test_probe_tick_sets_gauges_and_counts():
+    m = Metrics()
+    probe = resources.ResourceProbe(metrics=m, interval_s=60.0)
+    probe.tick()
+    assert probe.ticks == 1
+    if sys.platform.startswith("linux"):
+        assert m.gauge(mm.PROC_RSS).value > 0
+        assert m.gauge(mm.PROC_FDS).value > 0
+    assert m.gauge(mm.PROC_THREADS).value >= 1
+    assert m.gauge(mm.PROC_PRESSURE_FLIGHT_RING).value >= 0
+
+
+def test_probe_rejects_nonpositive_interval():
+    with pytest.raises(ValueError):
+        resources.ResourceProbe(interval_s=0)
+
+
+# -- leak sentinel ------------------------------------------------------------
+
+
+def _feed(sentinel, series, values, dt=1.0):
+    tripped = False
+    for i, v in enumerate(values):
+        tripped = sentinel.observe(series, i * dt, v) or tripped
+    return tripped
+
+
+def test_sentinel_no_trip_on_flat_series():
+    s = slope.LeakSentinel(metrics=Metrics(), min_samples=4, min_horizon_s=5.0)
+    assert not _feed(s, "rss", [100.0] * 64)
+    assert not s.tripped()
+
+
+def test_sentinel_no_trip_on_noisy_stationary_series():
+    # alternating spikes with zero trend: Theil–Sen's pairwise median
+    # must read ~0 where least squares would chase the spikes
+    vals = [1000.0 + (50.0 if i % 2 else -50.0) for i in range(64)]
+    s = slope.LeakSentinel(metrics=Metrics(), min_samples=4, min_horizon_s=5.0)
+    assert not _feed(s, "rss", vals)
+    assert not s.tripped()
+
+
+def test_sentinel_no_trip_below_minimum_horizon():
+    # steep planted slope, but the whole window spans < min_horizon_s:
+    # an extrapolation is not a measurement
+    s = slope.LeakSentinel(metrics=Metrics(), min_samples=4,
+                           min_horizon_s=1e6)
+    assert not _feed(s, "rss", [float(i) * 1e9 for i in range(64)])
+    assert not s.tripped()
+
+
+def test_sentinel_trips_on_planted_slope_with_evidence(tmp_path):
+    flight.configure(capacity=64, service="sentinel-test",
+                     dir=str(tmp_path))
+    m = Metrics()
+    s = slope.LeakSentinel(metrics=m, min_samples=4, min_horizon_s=5.0,
+                           thresholds={"rss": 10.0})
+    assert _feed(s, "rss", [1000.0 + 100.0 * i for i in range(16)])
+    assert s.tripped("rss")
+    assert m.counter(mm.HEALTH_LEAK_SUSPECT).value == 1
+    g = m.gauge(f"{mm.HEALTH_LEAK_SLOPE}.rss").value
+    assert g == pytest.approx(100.0)
+    # the trip dumped the flight ring with the leak record inside
+    dumps = [p for p in os.listdir(tmp_path) if p.endswith("-leak.json")]
+    assert len(dumps) == 1
+    payload = json.load(open(tmp_path / dumps[0]))
+    kinds = [e["kind"] for e in payload["events"]]
+    assert "leak.suspect" in kinds
+    flight.configure()  # restore a default recorder for later tests
+
+
+def test_sentinel_latch_is_per_series():
+    """A tripped rss watch must not silence a later fd leak — and the
+    tripped series itself stays latched (one trip, one dump)."""
+    m = Metrics()
+    s = slope.LeakSentinel(metrics=m, min_samples=4, min_horizon_s=5.0,
+                           thresholds={"rss": 10.0, "fds": 1.0})
+    assert _feed(s, "rss", [100.0 * i for i in range(16)])
+    # more rss growth: latched, no second trip
+    assert not _feed(s, "rss", [10000.0 + 100.0 * i for i in range(16)])
+    assert m.counter(mm.HEALTH_LEAK_SUSPECT).value == 1
+    # an independent fd leak still trips
+    assert _feed(s, "fds", [10.0 * i for i in range(16)])
+    assert s.tripped("fds") and s.tripped("rss")
+    assert m.counter(mm.HEALTH_LEAK_SUSPECT).value == 2
+
+
+def test_sentinel_relative_rule_and_slope_accessor():
+    s = slope.LeakSentinel(metrics=Metrics(), min_samples=4,
+                           min_horizon_s=5.0, rel_slope_per_hour=0.10)
+    # 1/s on a level of ~1e6: 3600/1e6 = 0.36%/hour — under the 10% rule
+    assert not _feed(s, "rss", [1e6 + float(i) for i in range(32)])
+    assert s.slope("rss") == pytest.approx(1.0)
+    # same absolute slope on a level of ~100: way over 10%/hour
+    assert _feed(s, "fds", [100.0 + float(i) for i in range(32)])
+
+
+def test_sentinel_routes_through_health_monitor():
+    from distributed_sgd_tpu.telemetry.health import HealthMonitor
+
+    m = Metrics()
+    monitor = HealthMonitor(metrics=m, action="warn")
+    s = slope.LeakSentinel(metrics=m, min_samples=4, min_horizon_s=5.0,
+                           thresholds={"rss": 10.0})
+    s.attach_health(monitor)
+    assert _feed(s, "rss", [100.0 * i for i in range(16)])
+    assert monitor.tripped
+    assert monitor.trip_reason == "leak:rss"
+
+
+# -- blackbox -----------------------------------------------------------------
+
+
+def test_blackbox_appends_rotates_and_bounds(tmp_path):
+    box = blackbox_mod.Blackbox(str(tmp_path), service="t",
+                                max_segment_bytes=512, max_segments=3)
+    for i in range(64):
+        box.append({"resources": {mm.PROC_RSS: 1000.0 + i}, "round": i})
+    names = sorted(os.listdir(tmp_path))
+    assert names, "no segments written"
+    assert all(n.startswith("bb-t-") and n.endswith(".jsonl") for n in names)
+    # the ring is bounded: at most max_segments files ever
+    assert len(names) <= 3
+    total = sum(os.path.getsize(tmp_path / n) for n in names)
+    assert total <= 3 * 512 + 1024  # bound + one in-flight record of slack
+    # records merge time-ordered and the NEWEST survived rotation
+    records = blackbox_mod.read_records(str(tmp_path))
+    rounds = [r["round"] for r in records]
+    assert rounds == sorted(rounds)
+    assert rounds[-1] == 63
+
+
+def test_blackbox_reader_skips_torn_final_line(tmp_path):
+    box = blackbox_mod.Blackbox(str(tmp_path), service="t")
+    box.append({"round": 1})
+    box.append({"round": 2})
+    # crash mid-write: a torn trailing line
+    with open(box._path, "a") as f:
+        f.write('{"round": 3, "resour')
+    records = blackbox_mod.read_records(str(tmp_path))
+    assert [r["round"] for r in records] == [1, 2]
+
+
+def test_blackbox_never_raises_on_unusable_dir(tmp_path):
+    # a PATH that cannot be a directory (it's a file): makedirs fails at
+    # construction, append goes quiet, readers see nothing.  (A chmod-
+    # based denial wouldn't hold under root, which CI runs as.)
+    deny = tmp_path / "deny"
+    deny.write_text("not a directory")
+    box = blackbox_mod.Blackbox(str(deny), service="t")
+    assert box._failed
+    box.append({"round": 1})  # must not raise
+    assert blackbox_mod.read_records(str(deny)) == []
+
+
+def test_blackbox_cli_tail_merge_summary(tmp_path):
+    box = blackbox_mod.Blackbox(str(tmp_path), service="cli")
+    for i in range(8):
+        box.append({"resources": {mm.PROC_RSS: 1e6 + 1000.0 * i,
+                                  mm.PROC_FDS: 10.0},
+                    "round": i})
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_sgd_tpu.telemetry.blackbox",
+         "summary", str(tmp_path)],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    summary = json.loads(out.stdout)
+    assert summary["snapshots"] == 8
+    assert summary["last_round"] == 7
+    assert mm.PROC_RSS in summary["slopes_per_s"]
+    # fds were flat: slope ~0
+    assert summary["slopes_per_s"][mm.PROC_FDS] == pytest.approx(0.0)
+
+    out = subprocess.run(
+        [sys.executable, "-m", "distributed_sgd_tpu.telemetry.blackbox",
+         "tail", "-n", "3", str(tmp_path)],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    tail = [json.loads(ln) for ln in out.stdout.splitlines()]
+    assert [r["round"] for r in tail] == [5, 6, 7]
+
+
+# -- the planted leak, end to end ---------------------------------------------
+
+
+def test_planted_leak_trips_probe_sentinel_blackbox(tmp_path):
+    """The acceptance path: a planted leak (test hook) drives the FULL
+    production pipeline — probe tick -> gauges -> sentinel trip ->
+    flight dump -> readable blackbox."""
+    flight.configure(capacity=64, service="plant-test", dir=str(tmp_path))
+    m = Metrics()
+    leak = {"v": 0.0}
+
+    def plant():
+        leak["v"] += 1.0
+        return {"plant.leak": 100.0 * leak["v"]}
+
+    # min_horizon 0 disarms the time guard, so the REAL rss/fds/threads
+    # series this probe also watches could trip on incidental drift across
+    # 16 sub-second ticks — pin them behind unreachable absolute bars so
+    # the planted series is deterministically the only trip
+    sentinel = slope.LeakSentinel(metrics=m, min_samples=4,
+                                  min_horizon_s=0.0,
+                                  thresholds={"plant.leak": 10.0,
+                                              "rss": 1e18, "fds": 1e18,
+                                              "threads": 1e18})
+    box = blackbox_mod.Blackbox(str(tmp_path / "bb"), service="plant",
+                                metrics=m)
+    probe = resources.ResourceProbe(metrics=m, interval_s=60.0,
+                                    sentinel=sentinel, blackbox=box,
+                                    plant=plant)
+    for _ in range(16):
+        probe.tick()
+    assert sentinel.tripped("plant.leak")
+    assert m.counter(mm.HEALTH_LEAK_SUSPECT).value == 1
+    # the planted series reached the gauges (production path, not a stub)
+    assert m.gauge("plant.leak").value == pytest.approx(1600.0)
+    # flight dump exists and embeds the resources section (satellite:
+    # every dump carries RSS/fd/thread context)
+    dumps = [p for p in os.listdir(tmp_path) if p.endswith("-leak.json")]
+    assert len(dumps) == 1
+    payload = json.load(open(tmp_path / dumps[0]))
+    assert payload["resources"] is not None
+    assert mm.PROC_THREADS in payload["resources"]
+    # blackbox is readable and carries the counter plane + the leak series
+    records = blackbox_mod.read_records(str(tmp_path / "bb"))
+    assert len(records) == 16
+    assert records[-1]["resources"]["plant.leak"] == pytest.approx(1600.0)
+    assert mm.BLACKBOX_SNAPSHOTS in records[-1]["counters"]
+    summary = blackbox_mod.summarize(records)
+    assert summary["snapshots"] == 16
+    flight.configure()
+
+
+def test_flight_dump_embeds_resources_section(tmp_path):
+    """Satellite: EVERY dump reason — not just leak trips — now carries
+    the resource snapshot."""
+    rec = flight.FlightRecorder(capacity=8, service="res-test",
+                                dir=str(tmp_path))
+    rec.record("anything", x=1)
+    path = rec.dump("quorum")
+    payload = json.load(open(path))
+    assert payload["resources"] is not None
+    if sys.platform.startswith("linux"):
+        assert payload["resources"][mm.PROC_RSS] > 0
+
+
+# -- knobs-off contract -------------------------------------------------------
+
+
+def test_knobs_off_no_probe_thread_no_files(tmp_path):
+    from distributed_sgd_tpu.config import Config
+
+    cfg = Config()
+    assert cfg.resource_probe_s == 0.0
+    assert cfg.blackbox_dir is None
+    # the module-level gate: interval 0 installs nothing
+    assert resources.configure(0.0) is None
+    assert resources.active() is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "resource-probe"]
+    # and no blackbox file ever appears without a probe writing one
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_probe_thread_lifecycle():
+    probe = resources.configure(60.0, metrics=Metrics())
+    try:
+        assert resources.active() is probe
+        assert [t for t in threading.enumerate()
+                if t.name == "resource-probe"]
+    finally:
+        assert resources.configure(0.0) is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "resource-probe"]
+
+
+def test_config_validation_and_env():
+    from distributed_sgd_tpu.config import Config
+
+    with pytest.raises(ValueError, match="DSGD_RESOURCE_PROBE_S"):
+        Config(resource_probe_s=-1.0)
+    with pytest.raises(ValueError, match="DSGD_BLACKBOX_DIR"):
+        Config(blackbox_dir="/tmp/bb")  # needs a probe cadence
+    cfg = Config(resource_probe_s=5.0, blackbox_dir="/tmp/bb")
+    assert cfg.resource_probe_s == 5.0
+
+    env = {"DSGD_RESOURCE_PROBE_S": "2.5", "DSGD_BLACKBOX_DIR": "/tmp/x"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        cfg = Config.from_env()
+        assert cfg.resource_probe_s == 2.5
+        assert cfg.blackbox_dir == "/tmp/x"
+    finally:
+        for k, v in old.items():
+            os.environ.pop(k, None) if v is None else os.environ.update({k: v})
+
+
+# -- HA router registry isolation (satellite) ---------------------------------
+
+
+def test_two_routers_default_to_isolated_registries():
+    """PR 19's HA pairs run two routers in one process: defaulted metrics
+    must be per-router (the serve:<port> fix from PR 7 never covered the
+    route role) so one cluster /metrics page can't double-count."""
+    from distributed_sgd_tpu.serving.router import ServingRouter
+    from distributed_sgd_tpu.utils.metrics import global_metrics
+
+    r1 = ServingRouter([("127.0.0.1", 1)], host="127.0.0.1",
+                       telemetry_port=0)
+    r2 = ServingRouter([("127.0.0.1", 1)], host="127.0.0.1",
+                       telemetry_port=0)
+    try:
+        assert r1.metrics is not r2.metrics
+        assert r1.metrics is not global_metrics()
+        assert r2.metrics is not global_metrics()
+        # counter isolation: traffic on one router never shows on the other
+        r1.metrics.counter("route.requests").increment(5)
+        assert r2.metrics.counter("route.requests").value == 0
+        # each telemetry plane exports ONLY its own route:<port> node label
+        t1 = r1.telemetry.prometheus_text()
+        t2 = r2.telemetry.prometheus_text()
+        assert r1._node != r2._node
+        assert r1._node in t1 and r2._node not in t1
+        assert r2._node in t2 and r1._node not in t2
+    finally:
+        r1.stop(grace=0.1)
+        r2.stop(grace=0.1)
